@@ -1,0 +1,36 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of Deeplearning4j
+(reference: /root/reference @ 0.6.1/0.7.2-SNAPSHOT era): serializable layer/graph
+configuration DSL, sequential and DAG network runtimes, pluggable updaters with
+LR schedules and gradient clipping, dataset pipelines with prefetch, evaluation,
+early stopping, checkpoint/resume, observability, embedding models, Keras import,
+and distributed data/tensor/sequence parallelism over TPU meshes.
+
+Architecture (TPU-first, NOT a port):
+  - All layer forward passes are pure functions; backprop is ``jax.grad`` —
+    replacing the reference's hand-written ``Layer.backpropGradient`` pairs
+    (e.g. reference ``nn/layers/BaseLayer.java:143-167``).
+  - Parameters are pytrees, not flattened buffers (reference
+    ``MultiLayerNetwork.java:368`` flattenedParams); XLA fuses and donates.
+  - Distribution is ``jax.sharding.Mesh`` + collectives over ICI/DCN —
+    replacing Spark parameter averaging (reference
+    ``ParameterAveragingTrainingMaster.java``) and ``ParallelWrapper``.
+"""
+
+__version__ = "0.1.0"
+
+# Lazy module surface: keep `import deeplearning4j_tpu` light.
+_SUBMODULES = {
+    "nn", "optimize", "eval", "datasets", "parallel", "models", "nlp",
+    "graph", "modelimport", "ui", "util", "ops", "losses", "dtypes", "rng",
+}
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+        mod = importlib.import_module(f"{__name__}.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

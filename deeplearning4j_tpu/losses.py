@@ -1,0 +1,185 @@
+"""Loss functions (ILossFunction parity).
+
+The reference delegates loss computation to ND4J ``ILossFunction`` impls
+(used from ``nn/layers/BaseOutputLayer.java:92-115``): each computes a score
+and a hand-written gradient w.r.t. pre-output. Here each loss is a pure
+function of (labels, pre_output) — gradients come from ``jax.grad``; the
+softmax/sigmoid + cross-entropy pairs are fused in logit space for numerical
+stability (what the reference achieves by special-casing inside LossMCXENT).
+
+Naming parity with the reference's LossFunction enum: MSE, L2, MAE/L1, XENT,
+MCXENT, NEGATIVELOGLIKELIHOOD, HINGE, SQUARED_HINGE, KL_DIVERGENCE, MAPE,
+MSLE, POISSON, COSINE_PROXIMITY.
+
+Per-example semantics (matching the ND4J impls):
+  L2   = sum_j (y-yhat)^2        MSE  = L2 / n_outputs
+  L1   = sum_j |y-yhat|          MAE  = L1 / n_outputs
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .nn import activations as _act
+
+EPS = 1e-7
+
+# A loss fn maps (labels, pre_output, activation_name) -> per-(example,output)
+# loss array of the same shape as labels (before any mask/reduction).
+LossFn = Callable[[jax.Array, jax.Array, str], jax.Array]
+
+_REGISTRY: Dict[str, LossFn] = {}
+
+
+def register(*names: str):
+    def deco(fn):
+        for n in names:
+            _REGISTRY[n.lower()] = fn
+        return fn
+    return deco
+
+
+def get(name: str) -> LossFn:
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+def _activate(pre, activation):
+    return _act.get(activation)(pre)
+
+
+@register("mse", "squared_loss")
+def mse(labels, pre, activation):
+    d = _activate(pre, activation) - labels
+    return d * d / labels.shape[-1]
+
+
+@register("l2")
+def l2(labels, pre, activation):
+    d = _activate(pre, activation) - labels
+    return d * d
+
+
+@register("mae", "mean_absolute_error")
+def mae(labels, pre, activation):
+    return jnp.abs(_activate(pre, activation) - labels) / labels.shape[-1]
+
+
+@register("l1")
+def l1(labels, pre, activation):
+    return jnp.abs(_activate(pre, activation) - labels)
+
+
+@register("xent", "binary_xent", "reconstruction_crossentropy")
+def xent(labels, pre, activation):
+    """Binary cross-entropy. Fused in logit space when activation is sigmoid."""
+    if activation.lower() == "sigmoid":
+        # -[y*log sig(x) + (1-y)*log(1-sig(x))] = max(x,0) - x*y + log(1+exp(-|x|))
+        return jnp.maximum(pre, 0) - pre * labels + jnp.log1p(jnp.exp(-jnp.abs(pre)))
+    p = jnp.clip(_activate(pre, activation), EPS, 1.0 - EPS)
+    return -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+
+
+@register("mcxent", "negativeloglikelihood")
+def mcxent(labels, pre, activation):
+    """Multi-class cross-entropy. Fused log-softmax when activation is softmax."""
+    if activation.lower() == "softmax":
+        logp = jax.nn.log_softmax(pre, axis=-1)
+        return -labels * logp
+    p = jnp.clip(_activate(pre, activation), EPS, 1.0 - EPS)
+    return -labels * jnp.log(p)
+
+
+@register("hinge")
+def hinge(labels, pre, activation):
+    # labels in {-1, +1}
+    out = _activate(pre, activation)
+    return jnp.maximum(0.0, 1.0 - labels * out)
+
+
+@register("squared_hinge")
+def squared_hinge(labels, pre, activation):
+    h = hinge(labels, pre, activation)
+    return h * h
+
+
+@register("kl_divergence", "kld")
+def kld(labels, pre, activation):
+    p = jnp.clip(_activate(pre, activation), EPS, 1.0 - EPS)
+    y = jnp.clip(labels, EPS, 1.0)
+    return y * (jnp.log(y) - jnp.log(p))
+
+
+@register("mape", "mean_absolute_percentage_error")
+def mape(labels, pre, activation):
+    out = _activate(pre, activation)
+    return 100.0 * jnp.abs((labels - out) / jnp.where(jnp.abs(labels) < EPS, EPS, labels)) / labels.shape[-1]
+
+
+@register("msle", "mean_squared_logarithmic_error")
+def msle(labels, pre, activation):
+    out = _activate(pre, activation)
+    d = jnp.log1p(jnp.maximum(out, -1 + EPS)) - jnp.log1p(jnp.maximum(labels, -1 + EPS))
+    return d * d / labels.shape[-1]
+
+
+@register("poisson")
+def poisson(labels, pre, activation):
+    out = jnp.maximum(_activate(pre, activation), EPS)
+    return out - labels * jnp.log(out)
+
+
+@register("cosine_proximity")
+def cosine_proximity(labels, pre, activation):
+    out = _activate(pre, activation)
+    ln = jnp.linalg.norm(labels, axis=-1, keepdims=True)
+    on = jnp.linalg.norm(out, axis=-1, keepdims=True)
+    cos = jnp.sum(labels * out, axis=-1, keepdims=True) / jnp.maximum(ln * on, EPS)
+    # Broadcast so the per-element array keeps labels' shape; sum over features
+    # then yields n_out * (-cos)/n_out = -cos per example.
+    return -cos * jnp.ones_like(labels) / labels.shape[-1]
+
+
+def score_array(loss_name: str, labels, pre_output, activation: str,
+                mask: Optional[jax.Array] = None) -> jax.Array:
+    """Per-example loss (summed over output features), mask applied.
+
+    mask may be None, shape [batch], or broadcastable to labels' shape —
+    matching the reference's per-output and per-timestep mask handling.
+    """
+    per_elem = get(loss_name)(labels, pre_output, activation)
+    if mask is not None:
+        m = mask
+        while m.ndim < per_elem.ndim:
+            m = m[..., None]
+        per_elem = per_elem * m
+    # sum over all non-batch axes
+    axes = tuple(range(1, per_elem.ndim))
+    return jnp.sum(per_elem, axis=axes) if axes else per_elem
+
+
+def score(loss_name: str, labels, pre_output, activation: str,
+          mask: Optional[jax.Array] = None, average: bool = True) -> jax.Array:
+    """Scalar loss. With a mask, averaging divides by the active count
+    (parity with reference masked-score semantics in BaseOutputLayer)."""
+    arr = score_array(loss_name, labels, pre_output, activation, mask)
+    total = jnp.sum(arr)
+    if not average:
+        return total
+    if mask is not None and mask.ndim >= 1:
+        # count of active examples/timesteps (mask broadcast over features)
+        if mask.ndim == labels.ndim:
+            denom = jnp.maximum(jnp.sum(jnp.max(mask, axis=-1)), 1.0) if mask.shape[-1] == labels.shape[-1] else jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return total / denom
+    return total / labels.shape[0]

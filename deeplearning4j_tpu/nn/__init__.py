@@ -1,0 +1,1 @@
+"""Neural network package: config DSL, functional layers, runtime networks."""

@@ -1,0 +1,14 @@
+"""Configuration DSL: serializable layer/network configs with shape inference.
+
+Parity target: reference ``nn/conf/`` (NeuralNetConfiguration.Builder,
+MultiLayerConfiguration, ComputationGraphConfiguration, layer configs,
+InputType-driven nIn inference and automatic preprocessor insertion).
+"""
+
+from .inputs import InputType
+from .builders import NeuralNetConfiguration, ListBuilder
+from .multi_layer import MultiLayerConfiguration
+
+__all__ = [
+    "InputType", "NeuralNetConfiguration", "ListBuilder", "MultiLayerConfiguration",
+]

@@ -1,0 +1,154 @@
+"""Input preprocessors: shape adapters auto-inserted between layer families.
+
+Parity: reference ``nn/conf/preprocessor/`` (CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor).
+
+Functional design: each preprocessor is a pure reshape/transpose on the
+forward activations; the backward pass is derived by autodiff, so the
+reference's hand-written ``backprop()`` methods have no analog here.
+Mask transformation (``feedForwardMaskArray`` in the reference) is the
+``transform_mask`` hook.
+
+Layout note: CNN activations here are NHWC (TPU-native), so
+CnnToFeedForward flattens in (h, w, c) order — this is recorded in the
+serialized config so Keras/NCHW importers can insert permutations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Type
+
+import jax.numpy as jnp
+
+from .inputs import InputType
+
+_REGISTRY: Dict[str, Type["InputPreProcessor"]] = {}
+
+
+def register_preprocessor(name: str):
+    def deco(cls):
+        cls._type_name = name
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def preprocessor_from_dict(d) -> "InputPreProcessor":
+    d = dict(d)
+    typ = d.pop("type")
+    return _REGISTRY[typ](**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputPreProcessor:
+    _type_name = "base"
+
+    def __call__(self, x, minibatch_size=None):
+        raise NotImplementedError
+
+    def transform_mask(self, mask, minibatch_size=None):
+        return mask
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self):
+        return {"type": self._type_name, **dataclasses.asdict(self)}
+
+
+@register_preprocessor("cnn_to_feedforward")
+@dataclasses.dataclass(frozen=True)
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, minibatch_size=None):  # [b,h,w,c] -> [b, h*w*c]
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(input_type.height * input_type.width
+                                      * input_type.channels)
+
+
+@register_preprocessor("feedforward_to_cnn")
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, minibatch_size=None):  # [b, h*w*c] -> [b,h,w,c]
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor("rnn_to_feedforward")
+@dataclasses.dataclass(frozen=True)
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, t, f] -> [b*t, f] (time-distributed dense).
+
+    Parity: reference RnnToFeedForwardPreProcessor (which permutes an
+    f-ordered NDArray; here a plain reshape has the same row semantics).
+    """
+
+    def __call__(self, x, minibatch_size=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def transform_mask(self, mask, minibatch_size=None):
+        return None if mask is None else mask.reshape(-1)
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+
+@register_preprocessor("feedforward_to_rnn")
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    minibatch: int = 0  # set at apply time via closure; stored for serde only
+
+    def __call__(self, x, minibatch_size=None):
+        b = minibatch_size if minibatch_size else self.minibatch
+        return x.reshape(b, -1, x.shape[-1])
+
+    def output_type(self, input_type):
+        return InputType.recurrent(input_type.size)
+
+
+@register_preprocessor("cnn_to_rnn")
+@dataclasses.dataclass(frozen=True)
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[b*t, h, w, c] -> [b, t, h*w*c]."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, minibatch_size=None):
+        b = minibatch_size or x.shape[0]
+        return x.reshape(b, -1, self.height * self.width * self.channels)
+
+    def output_type(self, input_type):
+        return InputType.recurrent(input_type.height * input_type.width
+                                   * input_type.channels)
+
+
+@register_preprocessor("rnn_to_cnn")
+@dataclasses.dataclass(frozen=True)
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[b, t, h*w*c] -> [b*t, h, w, c]."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, minibatch_size=None):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def transform_mask(self, mask, minibatch_size=None):
+        return None if mask is None else mask.reshape(-1)
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)

@@ -1,0 +1,122 @@
+"""Weight initialization schemes.
+
+Semantic parity with the reference's WeightInit enum + WeightInitUtil
+(reference ``nn/weights/WeightInit.java:48-54``,
+``nn/weights/WeightInitUtil.java:66-112``):
+
+  DISTRIBUTION    sample from a configured distribution
+  ZERO            zeros
+  SIGMOID_UNIFORM U(-r, r), r = 4*sqrt(6/(fanIn+fanOut))
+  UNIFORM         U(-a, a), a = 1/sqrt(fanIn)
+  XAVIER          N(0, 2/(fanIn+fanOut))
+  XAVIER_UNIFORM  U(-s, s), s = sqrt(6/(fanIn+fanOut))
+  XAVIER_FAN_IN   N(0, 1/fanIn)
+  XAVIER_LEGACY   N(0, 1/(shape[0]+shape[1]))
+  RELU            N(0, 2/fanIn)  (He init)
+  RELU_UNIFORM    U(-u, u), u = sqrt(6/fanIn)
+  NORMALIZED      (U(0,1) - 0.5) / shape[0]
+
+Implemented as pure functions of a PRNG key — no global RNG state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+VALID = (
+    "DISTRIBUTION", "ZERO", "ONES", "SIGMOID_UNIFORM", "UNIFORM", "XAVIER",
+    "XAVIER_UNIFORM", "XAVIER_FAN_IN", "XAVIER_LEGACY", "RELU", "RELU_UNIFORM",
+    "NORMALIZED", "IDENTITY", "LECUN_NORMAL", "LECUN_UNIFORM", "VAR_SCALING_NORMAL_FAN_AVG",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    """Serializable distribution spec for WeightInit.DISTRIBUTION.
+
+    Mirrors the reference's NormalDistribution/UniformDistribution/
+    BinomialDistribution config classes (``nn/conf/distribution/``).
+    """
+
+    kind: str = "normal"  # normal | uniform | constant
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    value: float = 0.0
+
+    def sample(self, key, shape, dtype):
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, self.lower, self.upper)
+        if self.kind == "constant":
+            return jnp.full(shape, self.value, dtype)
+        raise ValueError(f"unknown distribution kind {self.kind!r}")
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return Distribution(**d)
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: str,
+    fan_in: float,
+    fan_out: float,
+    distribution: Optional[Distribution] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    scheme = scheme.upper()
+    shape = tuple(shape)
+    if scheme == "ZERO":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ONES":
+        return jnp.ones(shape, dtype)
+    if scheme == "IDENTITY":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "DISTRIBUTION":
+        if distribution is None:
+            raise ValueError("WeightInit DISTRIBUTION requires a distribution")
+        return distribution.sample(key, shape, dtype)
+    if scheme == "NORMALIZED":
+        return (jax.random.uniform(key, shape, dtype) - 0.5) / shape[0]
+    if scheme == "XAVIER":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if scheme == "XAVIER_UNIFORM":
+        s = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -s, s)
+    if scheme == "XAVIER_FAN_IN":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "XAVIER_LEGACY":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(shape[0] + shape[1])
+    if scheme == "SIGMOID_UNIFORM":
+        r = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == "UNIFORM":
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "RELU":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+    if scheme == "RELU_UNIFORM":
+        u = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -u, u)
+    if scheme == "LECUN_NORMAL":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if scheme == "LECUN_UNIFORM":
+        b = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -b, b)
+    if scheme == "VAR_SCALING_NORMAL_FAN_AVG":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    raise ValueError(f"unknown WeightInit scheme {scheme!r}; valid: {VALID}")

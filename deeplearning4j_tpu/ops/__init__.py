@@ -1,0 +1,12 @@
+"""Low-level TPU ops: conv/pool lowerings, LRN, dropout, Pallas kernels.
+
+This package is the analog of the reference's "helper seam"
+(``nn/layers/convolution/ConvolutionLayer.java:66-74`` reflectively loading
+CudnnConvolutionHelper): the place where layer math meets hardware. Here the
+default lowering is XLA HLO (``lax.conv_general_dilated``, ``lax.reduce_window``
+— already MXU-tiled by XLA:TPU); Pallas kernels slot in where the profiler
+shows XLA underperforming (see ``pallas/``).
+"""
+
+from .convops import conv2d, pool2d, lrn, conv_output_size, same_pad  # noqa: F401
+from .common import dropout_mask, apply_dropout  # noqa: F401

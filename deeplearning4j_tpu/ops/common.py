@@ -1,0 +1,24 @@
+"""Common elementwise/random ops."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout_mask(rng, shape, rate: float, dtype=jnp.float32):
+    """Inverted-dropout mask: keep with prob (1-rate), scale kept by 1/(1-rate).
+
+    ``rate`` is the probability of dropping (Keras/modern convention; the
+    reference's util/Dropout.java applies ND4J DropOutInverted — same inverted
+    scaling, so train/test scaling semantics match).
+    """
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, p=keep, shape=shape)
+    return mask.astype(dtype) / keep
+
+
+def apply_dropout(rng, x, rate: float, train: bool):
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    return x * dropout_mask(rng, x.shape, rate, x.dtype)

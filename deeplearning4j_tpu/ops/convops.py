@@ -1,0 +1,115 @@
+"""Convolution / pooling / LRN lowerings (NHWC, TPU-native layout).
+
+Replaces the reference's im2col path (``nn/layers/convolution/
+ConvolutionLayer.java:251`` preOutput) and the CudnnConvolutionHelper /
+CudnnSubsamplingHelper / CudnnLocalResponseNormalizationHelper bindings
+(``deeplearning4j-cuda/.../CudnnConvolutionHelper.java:48``): on TPU a single
+``lax.conv_general_dilated`` HLO is tiled onto the MXU by XLA, and elementwise
+pre/post ops fuse into it — no descriptor/workspace management needed.
+
+Layouts: activations NHWC ``[batch, h, w, channels]``, kernels HWIO
+``[kh, kw, in_c, out_c]``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax import lax
+import jax.numpy as jnp
+
+Padding = Union[str, Tuple[int, int]]
+
+DIMSPEC = ("NHWC", "HWIO", "NHWC")
+
+
+def _pad_pairs(padding: Padding, kernel, stride, in_hw):
+    if isinstance(padding, str):
+        return padding.upper()  # "SAME" / "VALID" handled by lax
+    ph, pw = padding
+    return ((ph, ph), (pw, pw))
+
+
+def conv2d(x, w, stride=(1, 1), padding: Padding = (0, 0), dilation=(1, 1),
+           groups: int = 1, preferred_dtype=None):
+    """2D convolution, NHWC x HWIO -> NHWC."""
+    pad = _pad_pairs(padding, w.shape[:2], stride, x.shape[1:3])
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(stride),
+        padding=pad,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=DIMSPEC,
+        feature_group_count=groups,
+        preferred_element_type=preferred_dtype,
+    )
+
+
+def conv_output_size(in_size: int, kernel: int, stride: int, pad: int,
+                     dilation: int = 1) -> int:
+    """Output spatial size, strict mode (parity: util/ConvolutionUtils.java)."""
+    eff_k = kernel + (kernel - 1) * (dilation - 1)
+    return (in_size + 2 * pad - eff_k) // stride + 1
+
+
+def same_pad(in_size: int, kernel: int, stride: int) -> int:
+    out = -(-in_size // stride)
+    total = max(0, (out - 1) * stride + kernel - in_size)
+    return total // 2
+
+
+def pool2d(x, kind: str, kernel=(2, 2), stride=(2, 2), padding: Padding = (0, 0),
+           pnorm: int = 2):
+    """Pooling, NHWC. kind in {max, avg, sum, pnorm}.
+
+    Parity: reference SubsamplingLayer PoolingType {MAX, AVG, SUM, PNORM}.
+    """
+    kind = kind.lower()
+    window = (1, *kernel, 1)
+    strides = (1, *stride, 1)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        ph, pw = padding
+        pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+
+    if kind == "max":
+        init = -jnp.inf
+        return lax.reduce_window(x, init, lax.max, window, strides, pad)
+    if kind in ("avg", "sum"):
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        if kind == "sum":
+            return summed
+        if pad == "VALID" or (not isinstance(pad, str) and all(p == (0, 0) for p in pad)):
+            return summed / (kernel[0] * kernel[1])
+        # divide by actual window sizes at borders (count_include_pad=False)
+        ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+        return summed / counts
+    if kind == "pnorm":
+        p = float(pnorm)
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pad)
+        return s ** (1.0 / p)
+    raise ValueError(f"unknown pooling type {kind!r}")
+
+
+def lrn(x, k: float = 2.0, n: int = 5, alpha: float = 1e-4, beta: float = 0.75):
+    """Cross-channel local response normalization on NHWC.
+
+    y = x / (k + alpha * sum_{j in window(n)} x_j^2)^beta
+    Parity: reference nn/conf/layers/LocalResponseNormalization.java:25-28
+    (defaults n=5, k=2, alpha=1e-4, beta=0.75) and
+    CudnnLocalResponseNormalizationHelper.
+    """
+    sq = x * x
+    half = n // 2
+    # sum over a window of n channels: reduce_window over the channel axis
+    summed = lax.reduce_window(
+        sq, 0.0, lax.add,
+        window_dimensions=(1, 1, 1, n),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (0, 0), (0, 0), (half, n - 1 - half)),
+    )
+    return x / (k + alpha * summed) ** beta

@@ -1,0 +1,41 @@
+"""RNG utilities: named, splittable randomness.
+
+The reference threads a long `seed` through NeuralNetConfiguration
+(reference ``nn/conf/NeuralNetConfiguration.java:483``) into ND4J's global RNG.
+TPU-native equivalent: functional `jax.random` keys, derived deterministically
+by name so that parameter init and dropout streams are stable across replicas
+and across process restarts (required for multi-host determinism).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+
+
+def key(seed: int = 0) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def _name_to_int(name: str) -> int:
+    # Stable 32-bit hash (Python's hash() is salted per-process).
+    return int.from_bytes(hashlib.blake2s(name.encode(), digest_size=4).digest(), "big")
+
+
+def fold_name(k: jax.Array, name: str) -> jax.Array:
+    """Derive a sub-key deterministically from a string name."""
+    return jax.random.fold_in(k, _name_to_int(name))
+
+
+def split_named(k: jax.Array, names) -> dict:
+    return {n: fold_name(k, n) for n in names}
+
+
+def uniform(k, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(k, shape, dtype=dtype, minval=low, maxval=high)
+
+
+def normal(k, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    return mean + std * jax.random.normal(k, shape, dtype=dtype)

@@ -1,0 +1,24 @@
+"""Test bootstrap: force a virtual 8-device CPU mesh before JAX initializes.
+
+This is the analog of the reference's Spark `local[n]` test master
+(reference ``dl4j-spark/src/test/.../BaseSparkTest.java:90``): the full
+distributed code path exercised in a single process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Gradient checks run in float64 (parity with the reference's double-precision
+# gradient checks, GradientCheckUtil.java); enable x64 support globally.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,97 @@
+"""Substrate tests: weight init statistics, activations, loss values."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import losses
+from deeplearning4j_tpu.nn import activations, weights
+
+
+def test_weight_init_stats():
+    key = jax.random.PRNGKey(0)
+    shape = (400, 300)
+    fan_in, fan_out = shape
+    w = weights.init_weights(key, shape, "XAVIER", fan_in, fan_out)
+    assert abs(float(jnp.std(w)) - np.sqrt(2.0 / (fan_in + fan_out))) < 5e-4
+    w = weights.init_weights(key, shape, "RELU", fan_in, fan_out)
+    assert abs(float(jnp.std(w)) - np.sqrt(2.0 / fan_in)) < 5e-4
+    w = weights.init_weights(key, shape, "UNIFORM", fan_in, fan_out)
+    a = 1.0 / np.sqrt(fan_in)
+    assert float(jnp.max(jnp.abs(w))) <= a
+    w = weights.init_weights(key, shape, "ZERO", fan_in, fan_out)
+    assert float(jnp.sum(jnp.abs(w))) == 0.0
+
+
+def test_weight_init_distribution():
+    key = jax.random.PRNGKey(1)
+    d = weights.Distribution(kind="uniform", lower=-2.0, upper=2.0)
+    w = weights.init_weights(key, (100, 100), "DISTRIBUTION", 100, 100, d)
+    assert float(jnp.min(w)) >= -2.0 and float(jnp.max(w)) <= 2.0
+    assert weights.Distribution.from_dict(d.to_dict()) == d
+
+
+def test_activations():
+    x = jnp.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(activations.get("relu")(x),
+                               jnp.maximum(x, 0), atol=1e-6)
+    np.testing.assert_allclose(activations.get("identity")(x), x)
+    s = activations.get("softmax")(jnp.ones((2, 4)))
+    np.testing.assert_allclose(s, 0.25 * jnp.ones((2, 4)), atol=1e-6)
+    lr = activations.get("leakyrelu")(x)
+    np.testing.assert_allclose(lr, jnp.where(x >= 0, x, 0.01 * x), atol=1e-6)
+    # rationaltanh approximates tanh loosely
+    rt = activations.get("rationaltanh")(x)
+    assert float(rt[4]) > 0.9 and float(rt[0]) < -0.9
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError):
+        activations.get("nope")
+
+
+def test_mse_loss():
+    labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    pre = jnp.array([[0.5, 0.5], [0.0, 1.0]])
+    s = losses.score("mse", labels, pre, "identity")
+    # per-example: ((0.5^2+0.5^2)/2, 0) -> mean = 0.125
+    assert abs(float(s) - 0.125) < 1e-6
+
+
+def test_mcxent_softmax_fused_matches_direct():
+    key = jax.random.PRNGKey(2)
+    pre = jax.random.normal(key, (8, 5))
+    labels = jax.nn.one_hot(jnp.arange(8) % 5, 5)
+    fused = losses.score("mcxent", labels, pre, "softmax")
+    p = jax.nn.softmax(pre, axis=-1)
+    direct = -jnp.mean(jnp.sum(labels * jnp.log(p), axis=-1))
+    assert abs(float(fused) - float(direct)) < 1e-5
+
+
+def test_xent_sigmoid_fused():
+    pre = jnp.array([[2.0, -3.0]])
+    labels = jnp.array([[1.0, 0.0]])
+    s = losses.score("xent", labels, pre, "sigmoid", average=False)
+    p = jax.nn.sigmoid(pre)
+    direct = -(jnp.sum(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p)))
+    assert abs(float(s) - float(direct)) < 1e-5
+
+
+def test_masked_score():
+    labels = jnp.ones((4, 3))
+    pre = jnp.zeros((4, 3))
+    mask = jnp.array([1.0, 1.0, 0.0, 0.0])
+    s = losses.score("l2", labels, pre, "identity", mask=mask)
+    # only 2 active examples, each contributing 3.0 -> 6.0/2 = 3.0
+    assert abs(float(s) - 3.0) < 1e-6
+
+
+def test_loss_gradient_flows():
+    pre = jnp.array([[0.3, -0.2, 0.1]])
+    labels = jax.nn.one_hot(jnp.array([1]), 3)
+    g = jax.grad(lambda p: losses.score("mcxent", labels, p, "softmax"))(pre)
+    # d/dpre of softmax CE = softmax(pre) - labels
+    np.testing.assert_allclose(np.asarray(g),
+                               np.asarray(jax.nn.softmax(pre) - labels),
+                               atol=1e-5)
