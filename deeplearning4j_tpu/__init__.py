@@ -30,7 +30,13 @@ _SUBMODULES = {
 def __getattr__(name):
     if name in _SUBMODULES:
         import importlib
-        mod = importlib.import_module(f"{__name__}.{name}")
+        try:
+            mod = importlib.import_module(f"{__name__}.{name}")
+        except ModuleNotFoundError as e:
+            if e.name != f"{__name__}.{name}":
+                raise  # a real dependency is missing inside the submodule
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}") from e
         globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -169,17 +169,25 @@ def score_array(loss_name: str, labels, pre_output, activation: str,
 
 def score(loss_name: str, labels, pre_output, activation: str,
           mask: Optional[jax.Array] = None, average: bool = True) -> jax.Array:
-    """Scalar loss. With a mask, averaging divides by the active count
-    (parity with reference masked-score semantics in BaseOutputLayer)."""
+    """Scalar loss. With a mask, averaging divides by the active row count
+    (parity with reference masked-score semantics in BaseOutputLayer).
+
+    Explicit mask-kind contract (replaces shape-coincidence guessing):
+      - mask.ndim <  labels.ndim — a per-row mask ([b] or [b,t]); each entry
+        covers one example/timestep, so the denominator is ``sum(mask)``.
+      - mask.ndim == labels.ndim — a per-output mask; a row counts as active
+        if ANY of its outputs is unmasked, so the denominator is
+        ``sum(any(mask, axis=-1))``.
+    """
     arr = score_array(loss_name, labels, pre_output, activation, mask)
     total = jnp.sum(arr)
     if not average:
         return total
     if mask is not None and mask.ndim >= 1:
-        # count of active examples/timesteps (mask broadcast over features)
-        if mask.ndim == labels.ndim:
-            denom = jnp.maximum(jnp.sum(jnp.max(mask, axis=-1)), 1.0) if mask.shape[-1] == labels.shape[-1] else jnp.maximum(jnp.sum(mask), 1.0)
-        else:
+        if mask.ndim == labels.ndim:           # per-output mask
+            row_active = jnp.max(mask, axis=-1)
+            denom = jnp.maximum(jnp.sum(row_active), 1.0)
+        else:                                   # per-row (example/timestep) mask
             denom = jnp.maximum(jnp.sum(mask), 1.0)
         return total / denom
     return total / labels.shape[0]

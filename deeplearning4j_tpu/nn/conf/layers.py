@@ -323,6 +323,18 @@ class EmbeddingLayer(FeedForwardLayer):
 
     has_bias: bool = True
 
+    def param_shapes(self, policy=None):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key, policy=None):
+        params = super().init_params(key, policy)
+        if not self.has_bias:
+            params.pop("b", None)
+        return params
+
     def pre_output(self, params, x, *, policy=None):
         policy = policy or _dtypes.default_policy()
         idx = x.astype(jnp.int32)
@@ -520,6 +532,8 @@ class BatchNormalization(Layer):
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None, policy=None):
+        if not state:
+            state = self.init_state(policy)
         axes = tuple(range(x.ndim - 1))  # all but channel
         if train:
             mean = jnp.mean(x, axis=axes)
@@ -599,5 +613,9 @@ class GlobalPoolingLayer(Layer):
             return jnp.sum(x, axis=axes), state
         if kind == "pnorm":
             p = float(self.pnorm)
+            if x.ndim == 3 and mask is not None:
+                # zero masked timesteps so they don't contribute to the p-norm
+                # (parity: reference MaskedReductionUtil PNORM handling)
+                x = x * mask[..., None].astype(x.dtype)
             return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p), state
         raise ValueError(f"unknown pooling type {kind!r}")

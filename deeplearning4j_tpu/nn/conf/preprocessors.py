@@ -109,11 +109,22 @@ class RnnToFeedForwardPreProcessor(InputPreProcessor):
 @register_preprocessor("feedforward_to_rnn")
 @dataclasses.dataclass(frozen=True)
 class FeedForwardToRnnPreProcessor(InputPreProcessor):
-    minibatch: int = 0  # set at apply time via closure; stored for serde only
+    minibatch: int = 0  # optional serde-carried fallback; runtime passes minibatch_size
 
     def __call__(self, x, minibatch_size=None):
         b = minibatch_size if minibatch_size else self.minibatch
+        if not b:
+            raise ValueError(
+                "FeedForwardToRnnPreProcessor needs minibatch_size to "
+                "reconstruct the time axis from [b*t, f]; the network runtime "
+                "supplies it — pass minibatch_size= when calling directly")
         return x.reshape(b, -1, x.shape[-1])
+
+    def transform_mask(self, mask, minibatch_size=None):
+        if mask is None:
+            return None
+        b = minibatch_size if minibatch_size else self.minibatch
+        return mask.reshape(b, -1)
 
     def output_type(self, input_type):
         return InputType.recurrent(input_type.size)

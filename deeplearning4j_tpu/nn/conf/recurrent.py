@@ -129,6 +129,11 @@ class GravesLSTM(BaseRecurrentLayer):
     forget_gate_bias_init: float = 1.0
     gate_activation: str = "sigmoid"
 
+    def regularized_params(self):
+        # l1/l2 apply to input + recurrent weights, not bias/peepholes
+        # (parity: GravesLSTM.getL1ByParam — weights only).
+        return ("W", "RW")
+
     def param_shapes(self, policy=None):
         return {"W": (self.n_in, 4 * self.n_out),
                 "RW": (self.n_out, 4 * self.n_out),
@@ -218,7 +223,3 @@ class GravesBidirectionalLSTM(BaseRecurrentLayer):
 
     def regularized_params(self):
         return ("F_W", "F_RW", "B_W", "B_RW")
-
-
-# GravesLSTM regularization applies to W and RW (not bias/peepholes)
-GravesLSTM.regularized_params = lambda self: ("W", "RW")

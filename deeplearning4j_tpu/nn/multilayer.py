@@ -1,0 +1,460 @@
+"""MultiLayerNetwork: the sequential-stack runtime model.
+
+Parity: reference ``nn/multilayer/MultiLayerNetwork.java`` —
+``init`` (``:368``), ``feedForward`` (``:627``), ``output`` (``:1581``),
+``fit(DataSetIterator)`` (``:1037``), ``computeGradientAndScore`` (``:1867``),
+``doTruncatedBPTT`` (``:1079``), ``rnnTimeStep`` (``:2274``), ``score``
+(``:1900``).
+
+TPU-native design (NOT a port):
+  - Parameters are a pytree ``{"layer_0": {...}, ...}`` — not the reference's
+    single flattened F-order buffer with per-layer views
+    (``MultiLayerNetwork.java:368`` flattenedParams). XLA handles memory
+    layout; pytrees keep sharding/checkpointing structural.
+  - There is ONE jitted train step (donated params + optimizer state) that
+    fuses: forward through all layers, loss + l1/l2, ``jax.grad`` backward,
+    gradient normalization, and the updater apply. The reference's
+    Solver → ConvexOptimizer → Updater call chain (``Solver.java:41``,
+    ``StochasticGradientDescent.java:50-72``) collapses into this one
+    XLA program — no per-layer dispatch, no JNI hops.
+  - Backprop is autodiff through the forward functions; the reference's
+    hand-written ``calcBackpropGradients`` reverse loop
+    (``MultiLayerNetwork.java:1123-1190``) has no analog by design.
+  - Non-param layer state (BatchNorm running stats) and recurrent carry
+    (LSTM h/c) are threaded functionally and returned from the step.
+  - The iteration counter is a traced scalar so LR schedules compile into
+    the step instead of recompiling per iteration.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as _dtypes
+from .. import rng as _rng
+from ..optimize import updaters as _updaters
+from .conf.multi_layer import MultiLayerConfiguration
+
+Pytree = Any
+
+
+def _layer_key(i: int) -> str:
+    return f"layer_{i}"
+
+
+class MultiLayerNetwork:
+    """Runtime network over a :class:`MultiLayerConfiguration`."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self.training = conf.training
+        self.policy = _dtypes.policy_from_name(conf.training.dtype)
+        self.params: Optional[Dict[str, Dict[str, jax.Array]]] = None
+        self.state: Dict[str, Dict[str, jax.Array]] = {}
+        self.updater_state: Optional[Pytree] = None
+        self.listeners: List[Any] = []
+        self.iteration_count = 0   # minibatches seen (listener-visible)
+        self._update_count = 0     # parameter updates applied (tbptt chunks too)
+        self.epoch_count = 0
+        self._score: Optional[float] = None
+        self._rnn_state: Optional[List[Dict[str, jax.Array]]] = None
+        self._updater = None
+        self._jit_cache: Dict[str, Any] = {}
+
+        out = self.layers[-1]
+        self._has_loss_output = hasattr(out, "compute_score_array")
+
+    # ------------------------------------------------------------------
+    # init (parity: MultiLayerNetwork.init :368)
+    # ------------------------------------------------------------------
+
+    def init(self, key: Optional[jax.Array] = None) -> "MultiLayerNetwork":
+        if key is None:
+            key = _rng.key(self.training.seed)
+        params, state = {}, {}
+        for i, layer in enumerate(self.layers):
+            lk = _rng.fold_name(key, _layer_key(i))
+            params[_layer_key(i)] = layer.init_params(lk, self.policy)
+            state[_layer_key(i)] = layer.init_state(self.policy)
+        self.params = params
+        self.state = state
+        # persistent-state keys per layer (e.g. BN running stats), cached so
+        # the hot fit loop never re-calls init_state just to read key names
+        self._persistent_keys = [
+            tuple(layer.init_state(self.policy).keys()) for layer in self.layers]
+        self._updater = _updaters.make_updater(
+            self.training, self._lr_multipliers())
+        self.updater_state = self._updater.init(params)
+        return self
+
+    def _lr_multipliers(self) -> Pytree:
+        """Static per-param LR multiplier pytree (per-layer learning_rate and
+        bias_learning_rate overrides, reference conf.getLearningRateByParam)."""
+        base = float(self.training.learning_rate)
+        mults = {}
+        for i, layer in enumerate(self.layers):
+            layer_lr = layer.learning_rate if layer.learning_rate is not None else base
+            bias_lr = (layer.bias_learning_rate
+                       if layer.bias_learning_rate is not None else layer_lr)
+            mults[_layer_key(i)] = {
+                name: (bias_lr / base if name == "b" else layer_lr / base)
+                for name in layer.param_shapes(self.policy)
+            }
+        return mults
+
+    def num_params(self) -> int:
+        if self.params is None:
+            raise ValueError("call init() first")
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    # ------------------------------------------------------------------
+    # functional forward core
+    # ------------------------------------------------------------------
+
+    def _forward(self, params, states, x, *, train: bool, rng=None,
+                 mask=None, upto: Optional[int] = None,
+                 collect: bool = False):
+        """Thread input through preprocessors + layers.
+
+        Returns (activations | final activation, new_states).
+        `states` is a list of per-layer dicts; recurrent carry (h/c) rides in
+        the same dicts when present (TBPTT / rnnTimeStep).
+        """
+        upto = len(self.layers) if upto is None else upto
+        minibatch = x.shape[0]
+        cur, cur_mask = x, mask
+        acts = [x] if collect else None
+        new_states = []
+        for i in range(len(self.layers)):
+            if i >= upto:
+                new_states.append(states[i])
+                continue
+            layer = self.layers[i]
+            proc = self.conf.input_preprocessors.get(i)
+            if proc is not None:
+                cur = proc(cur, minibatch_size=minibatch)
+                cur_mask = proc.transform_mask(cur_mask, minibatch_size=minibatch)
+            lrng = None if rng is None else _rng.fold_name(rng, _layer_key(i))
+            cur, st = layer.apply(params[_layer_key(i)], cur,
+                                  state=states[i], train=train, rng=lrng,
+                                  mask=cur_mask, policy=self.policy)
+            new_states.append(st if st is not None else {})
+            if collect:
+                acts.append(cur)
+        return (acts if collect else cur), new_states
+
+    def _states_list(self, rnn_state=None):
+        out = []
+        for i in range(len(self.layers)):
+            st = dict(self.state.get(_layer_key(i), {}))
+            if rnn_state is not None and rnn_state[i]:
+                st.update(rnn_state[i])
+            out.append(st)
+        return out
+
+    def _persist_states(self, new_states):
+        """Keep only persistent (init_state-declared) entries, e.g. BN stats."""
+        for i, keys in enumerate(self._persistent_keys):
+            if keys:
+                self.state[_layer_key(i)] = {
+                    k: new_states[i][k] for k in keys if k in new_states[i]}
+
+    @staticmethod
+    def _extract_rnn_carry(new_states):
+        return [{k: v for k, v in st.items() if k in ("h", "c")}
+                for st in new_states]
+
+    # ------------------------------------------------------------------
+    # inference (parity: output :1581 / feedForward :627 / rnnTimeStep :2274)
+    # ------------------------------------------------------------------
+
+    def output(self, x, train: bool = False):
+        """Final-layer activations (compiled; cached across calls)."""
+        x = jnp.asarray(x)
+        fn = self._jit_cache.get("output")
+        if fn is None:
+            @jax.jit
+            def fn(params, states, x):
+                out, _ = self._forward(params, states, x, train=False)
+                return out
+            self._jit_cache["output"] = fn
+        return fn(self.params, self._states_list(), x)
+
+    def feed_forward(self, x, train: bool = False) -> List[jax.Array]:
+        """All layer activations, input first (parity: feedForward :627)."""
+        x = jnp.asarray(x)
+        acts, _ = self._forward(self.params, self._states_list(), x,
+                                train=train, collect=True)
+        return acts
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = None
+
+    def rnn_time_step(self, x):
+        """Streaming inference: feed one (or a few) timesteps, carrying h/c
+        (parity: rnnTimeStep :2274). x: [b, f] or [b, t, f]."""
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        states = self._states_list(self._rnn_state)
+        out, new_states = self._forward(self.params, states, x, train=False)
+        self._rnn_state = self._extract_rnn_carry(new_states)
+        return out[:, 0, :] if (squeeze and out.ndim == 3) else out
+
+    # ------------------------------------------------------------------
+    # score + gradients (parity: computeGradientAndScore :1867)
+    # ------------------------------------------------------------------
+
+    def _reg_penalty(self, params):
+        """l1 + 0.5*l2 penalties over each layer's regularized params
+        (parity: BaseLayer.calcL1/calcL2; gradient of 0.5*l2*||W||^2 is l2*W,
+        matching the reference's update)."""
+        if not self.training.regularization:
+            return 0.0
+        total = 0.0
+        for i, layer in enumerate(self.layers):
+            l1 = float(layer.l1 or 0.0)
+            l2 = float(layer.l2 or 0.0)
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            lp = params[_layer_key(i)]
+            for name in layer.regularized_params():
+                if name not in lp:
+                    continue
+                w = lp[name].astype(jnp.float32)
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(w))
+                if l2:
+                    total = total + 0.5 * l2 * jnp.sum(jnp.square(w))
+        return total
+
+    def _loss_fn(self, params, states, x, y, mask, rng):
+        if not self._has_loss_output:
+            raise ValueError(
+                "final layer has no loss (need OutputLayer/RnnOutputLayer/"
+                "LossLayer to train with fit())")
+        hidden, new_states = self._forward(
+            params, states, x, train=True, rng=rng, mask=mask,
+            upto=len(self.layers) - 1)
+        out_idx = len(self.layers) - 1
+        out_layer = self.layers[out_idx]
+        proc = self.conf.input_preprocessors.get(out_idx)
+        out_mask = mask
+        if proc is not None:
+            hidden = proc(hidden, minibatch_size=x.shape[0])
+            out_mask = proc.transform_mask(out_mask, minibatch_size=x.shape[0])
+        score_arr = out_layer.compute_score_array(
+            params[_layer_key(out_idx)], hidden, y, mask=out_mask,
+            policy=self.policy)
+        # denominator follows the explicit mask contract of losses.score:
+        # per-row masks divide by the active row/timestep count, per-output
+        # masks by rows with any active output; unmasked by batch size.
+        if out_mask is None:
+            denom = float(score_arr.shape[0])
+        elif out_mask.ndim == y.ndim:
+            denom = jnp.maximum(jnp.sum(jnp.max(out_mask, axis=-1)), 1.0)
+        else:
+            denom = jnp.maximum(jnp.sum(out_mask), 1.0)
+        loss = jnp.sum(score_arr) / denom
+        loss = loss + self._reg_penalty(params)
+        return loss.astype(jnp.float32), new_states
+
+    def score_for(self, x, y, mask=None) -> float:
+        """Loss on a batch without updating (parity: score via
+        computeGradientAndScore, eval mode)."""
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        loss, _ = self._loss_fn(self.params, self._states_list(), x, y,
+                                mask, None)
+        return float(loss)
+
+    def score(self) -> Optional[float]:
+        """Score from the most recent fit iteration (parity: score() :1900)."""
+        return self._score
+
+    def compute_gradient_and_score(self, x, y, mask=None):
+        """(gradients, score) for one batch — no update applied."""
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        (loss, _), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(
+                self.params, self._states_list(), x, y, mask, None)
+        return grads, float(loss)
+
+    # ------------------------------------------------------------------
+    # the jitted train step
+    # ------------------------------------------------------------------
+
+    def _make_train_step(self):
+        t = self.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = self._updater
+
+        def step(params, opt_state, states, x, y, mask, rng, iteration):
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, iteration)
+            params = _updaters.apply_updates(params, deltas)
+            return params, opt_state, new_states, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _train_step(self):
+        fn = self._jit_cache.get("train_step")
+        if fn is None:
+            fn = self._make_train_step()
+            self._jit_cache["train_step"] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # fit (parity: fit(DataSetIterator) :1037, doTruncatedBPTT :1079)
+    # ------------------------------------------------------------------
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def fit(self, data, labels=None, *, epochs: int = 1, mask=None) -> None:
+        """Train. `data` may be:
+          - (features, labels) arrays (`labels=None` form passes labels here),
+          - a DataSet (has .features/.labels),
+          - an iterator yielding DataSets or (features, labels) tuples.
+        """
+        if self.params is None:
+            self.init()
+        for epoch in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self, self.epoch_count)
+            n_batches = 0
+            for batch in self._as_batches(data, labels, mask):
+                self.fit_batch(*batch)
+                n_batches += 1
+            if n_batches == 0 and epoch > 0:
+                raise ValueError(
+                    f"epoch {epoch} yielded no batches — the data iterator is "
+                    "exhausted and has no reset(); pass a resettable iterator "
+                    "(e.g. datasets.ListDataSetIterator) when epochs > 1")
+            for l in self.listeners:
+                l.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+            if hasattr(data, "reset"):
+                data.reset()
+
+    @staticmethod
+    def _as_batches(data, labels, mask):
+        if labels is not None:
+            yield (data, labels, mask)
+            return
+        if hasattr(data, "features"):
+            yield (data.features, data.labels,
+                   getattr(data, "features_mask", None))
+            return
+        for item in data:
+            if hasattr(item, "features"):
+                yield (item.features, item.labels,
+                       getattr(item, "features_mask", None))
+            else:
+                x, y = item[0], item[1]
+                m = item[2] if len(item) > 2 else None
+                yield (x, y, m)
+
+    def fit_batch(self, x, y, mask=None) -> float:
+        """One minibatch update (tbptt-aware). Returns the score."""
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if mask is not None:
+            mask = jnp.asarray(mask)
+        if (self.conf.backprop_type == "truncated_bptt" and x.ndim == 3
+                and x.shape[1] > self.conf.tbptt_fwd_length):
+            return self._fit_tbptt(x, y, mask)
+        loss = self._step_and_update(x, y, mask, rnn_state=None)
+        self._fire_iteration(x.shape[0], loss)
+        return loss
+
+    def _fit_tbptt(self, x, y, mask) -> float:
+        """Truncated BPTT: slice [b,t,..] into fwd-length chunks, carrying
+        recurrent state across chunks with gradients stopped at the boundary
+        (parity: doTruncatedBPTT :1079)."""
+        length = self.conf.tbptt_fwd_length
+        T = x.shape[1]
+        rnn_state = self._zero_rnn_carry(x.shape[0])
+        loss = 0.0
+        for start in range(0, T, length):
+            end = min(start + length, T)
+            xs = x[:, start:end]
+            ys = y[:, start:end] if y.ndim == 3 else y
+            ms = mask[:, start:end] if (mask is not None and mask.ndim >= 2) else mask
+            loss = self._step_and_update(xs, ys, ms, rnn_state=rnn_state)
+            rnn_state = self._last_rnn_carry
+        self._fire_iteration(x.shape[0], loss)
+        return loss
+
+    def _zero_rnn_carry(self, batch):
+        carry = []
+        for layer in self.layers:
+            if hasattr(layer, "_zero_state"):
+                h, c = layer._zero_state(batch, self.policy)
+                carry.append({"h": h, "c": c})
+            else:
+                carry.append({})
+        return carry
+
+    def _step_and_update(self, x, y, mask, rnn_state) -> float:
+        # keyed on the update counter so each tbptt chunk gets a fresh dropout
+        # stream and the updater sees a monotonically advancing step
+        rng = _rng.fold_name(_rng.key(self.training.seed),
+                             f"update_{self._update_count}")
+        states = self._states_list(rnn_state)
+        it = jnp.asarray(self._update_count, jnp.int32)
+        params, opt_state, new_states, loss = self._train_step()(
+            self.params, self.updater_state, states, x, y, mask, rng, it)
+        self.params = params
+        self.updater_state = opt_state
+        self._update_count += 1
+        # stop-gradient boundary for tbptt: carry values, not graph
+        self._last_rnn_carry = jax.tree_util.tree_map(
+            jax.lax.stop_gradient, self._extract_rnn_carry(new_states))
+        self._persist_states(new_states)
+        self._score = float(loss)
+        return self._score
+
+    def _fire_iteration(self, batch_size, loss):
+        self.iteration_count += 1
+        for l in self.listeners:
+            if hasattr(l, "record_batch"):
+                l.record_batch(batch_size)
+            l.iteration_done(self, self.iteration_count, loss)
+
+    # ------------------------------------------------------------------
+    # evaluation bridge (full Evaluation class in eval/)
+    # ------------------------------------------------------------------
+
+    def evaluate(self, data, labels=None):
+        """Classification evaluation over an iterator or (x, y) arrays."""
+        from ..eval import Evaluation
+        ev = Evaluation()
+        for x, y, m in self._as_batches(data, labels, None):
+            out = self.output(jnp.asarray(x))
+            ev.eval(np.asarray(y), np.asarray(out), mask=None if m is None else np.asarray(m))
+        if hasattr(data, "reset"):
+            data.reset()
+        return ev
+
+    # ------------------------------------------------------------------
+    # serde bridge (full checkpoint container in util/serialization.py)
+    # ------------------------------------------------------------------
+
+    def clone_params(self):
+        return jax.tree_util.tree_map(lambda p: p, self.params)
+
+    def set_params(self, params) -> None:
+        self.params = params

@@ -1,0 +1,37 @@
+"""Training orchestration: updaters, LR schedules, gradient normalization,
+listeners.
+
+Parity target: reference ``optimize/`` (``Solver.java:41``,
+``solvers/BaseOptimizer.java``, ``solvers/StochasticGradientDescent.java``)
+and ``nn/updater/LayerUpdater.java:132-266``.
+
+TPU-native design: an updater is a pair of pure functions
+``(init(params) -> state, update(grads, state, params, iteration) -> (deltas,
+state))`` — pytree-in/pytree-out, jit-friendly, optimizer state donated along
+with params in the network train step. The reference's Solver/ConvexOptimizer
+iteration loop collapses into the network's single jitted train step; the
+LBFGS/CG solvers' line-search machinery is intentionally replaced by
+first-order updaters (the TPU-idiomatic training path).
+"""
+
+from .updaters import (
+    Updater,
+    make_updater,
+    learning_rate_at,
+    normalize_gradients,
+    apply_updates,
+)
+from .listeners import (
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresIterationListener,
+    ComposableIterationListener,
+)
+
+__all__ = [
+    "Updater", "make_updater", "learning_rate_at", "normalize_gradients",
+    "apply_updates", "TrainingListener", "ScoreIterationListener",
+    "PerformanceListener", "CollectScoresIterationListener",
+    "ComposableIterationListener",
+]

@@ -1,0 +1,151 @@
+"""Training listeners: per-iteration/epoch hooks fired by the fit loop.
+
+Parity: reference ``optimize/api/IterationListener.java`` /
+``TrainingListener.java`` (onEpochStart/End, onForwardPass,
+onGradientCalculation, onBackwardPass — fired at
+``MultiLayerNetwork.java:1046-1104``) and the impls in
+``optimize/listeners/``: ``ScoreIterationListener.java``,
+``PerformanceListener.java:71-86`` (samples/sec, batches/sec),
+``CollectScoresIterationListener.java``, ``ComposableIterationListener.java``.
+
+TPU-native note: the jitted train step runs async on device; listeners fire on
+the host *after* the step is dispatched. Reading `score` forces a device sync,
+so `PerformanceListener` reports true end-to-end throughput (device compute +
+host overhead), and listeners that don't need the score avoid blocking.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    """Hook bus contract. `model` is the network; `iteration` is the global
+    iteration counter (minibatches seen)."""
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        pass
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+    def on_forward_pass(self, model, activations) -> None:
+        pass
+
+    def on_gradient_calculation(self, model) -> None:
+        pass
+
+    def on_backward_pass(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (parity: ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10,
+                 log_fn: Optional[Callable[[str], None]] = None):
+        self.print_iterations = max(1, int(print_iterations))
+        self._log = log_fn or logger.info
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            self._log(f"Score at iteration {iteration} is {float(score)}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput reporting (parity: PerformanceListener.java:71-86 —
+    samples/sec and batches/sec over the reporting window)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True,
+                 report_sample: bool = True,
+                 log_fn: Optional[Callable[[str], None]] = None):
+        self.frequency = max(1, int(frequency))
+        self.report_batch = report_batch
+        self.report_sample = report_sample
+        self._log = log_fn or logger.info
+        self._last_time = None
+        self._last_iter = 0
+        self._samples = 0
+        self.last_samples_per_sec: Optional[float] = None
+        self.last_batches_per_sec: Optional[float] = None
+
+    def record_batch(self, batch_size: int) -> None:
+        self._samples += int(batch_size)
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples = 0
+            return
+        if (iteration - self._last_iter) >= self.frequency:
+            dt = max(now - self._last_time, 1e-9)
+            batches = iteration - self._last_iter
+            self.last_batches_per_sec = batches / dt
+            self.last_samples_per_sec = self._samples / dt
+            parts = []
+            if self.report_batch:
+                parts.append(f"{self.last_batches_per_sec:.2f} batches/sec")
+            if self.report_sample and self._samples:
+                parts.append(f"{self.last_samples_per_sec:.2f} samples/sec")
+            self._log(f"iteration {iteration}: " + ", ".join(parts))
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples = 0
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Accumulate (iteration, score) pairs in memory
+    (parity: CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, int(frequency))
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class ComposableIterationListener(TrainingListener):
+    """Fan one callback out to many (parity: ComposableIterationListener.java)."""
+
+    def __init__(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+
+    def record_batch(self, batch_size: int) -> None:
+        for l in self.listeners:
+            if hasattr(l, "record_batch"):
+                l.record_batch(batch_size)
+
+    def iteration_done(self, model, iteration, score):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, score)
+
+    def on_epoch_start(self, model, epoch):
+        for l in self.listeners:
+            l.on_epoch_start(model, epoch)
+
+    def on_epoch_end(self, model, epoch):
+        for l in self.listeners:
+            l.on_epoch_end(model, epoch)
+
+    def on_forward_pass(self, model, activations):
+        for l in self.listeners:
+            l.on_forward_pass(model, activations)
+
+    def on_gradient_calculation(self, model):
+        for l in self.listeners:
+            l.on_gradient_calculation(model)
+
+    def on_backward_pass(self, model):
+        for l in self.listeners:
+            l.on_backward_pass(model)

@@ -1,0 +1,225 @@
+"""MultiLayerNetwork runtime tests: init/fit/output/tbptt/rnnTimeStep.
+
+Parity model: reference MultiLayerNetwork tests (MultiLayerTest.java,
+BackPropMLPTest.java) — small nets on synthetic data, loss decrease and
+shape/semantics assertions.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, OutputLayer,
+    RnnOutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.recurrent import GravesLSTM
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import CollectScoresIterationListener
+
+
+def _toy_classification(rng, n=64, d=10, c=3):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def _dense_conf(updater="adam", lr=1e-2, **builder_kw):
+    b = NeuralNetConfiguration.builder().seed(42).updater(updater).learning_rate(lr)
+    for k, v in builder_kw.items():
+        getattr(b, k)(v)
+    return (b.list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+
+
+class TestDenseTraining:
+    def test_fit_reduces_loss_and_listener_fires(self, rng):
+        x, y = _toy_classification(rng)
+        net = MultiLayerNetwork(_dense_conf()).init()
+        collector = CollectScoresIterationListener()
+        net.set_listeners(collector)
+        s0 = net.score_for(x, y)
+        for _ in range(60):
+            net.fit_batch(x, y)
+        assert net.score() < s0 * 0.5
+        assert len(collector.scores) == 60
+        assert collector.scores[-1][1] < collector.scores[0][1]
+
+    def test_output_shape_and_softmax(self, rng):
+        x, y = _toy_classification(rng)
+        net = MultiLayerNetwork(_dense_conf()).init()
+        out = np.asarray(net.output(x))
+        assert out.shape == (64, 3)
+        assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_feed_forward_returns_all_activations(self, rng):
+        x, y = _toy_classification(rng)
+        net = MultiLayerNetwork(_dense_conf()).init()
+        acts = net.feed_forward(x)
+        assert len(acts) == 3  # input + 2 layers
+        assert acts[0].shape == (64, 10)
+        assert acts[1].shape == (64, 32)
+        assert acts[2].shape == (64, 3)
+
+    def test_num_params(self, rng):
+        net = MultiLayerNetwork(_dense_conf()).init()
+        # dense 10*32+32, output 32*3+3
+        assert net.num_params() == 10 * 32 + 32 + 32 * 3 + 3
+
+    def test_fit_with_iterator_and_epochs(self, rng):
+        x, y = _toy_classification(rng)
+        batches = [(x[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)]
+        net = MultiLayerNetwork(_dense_conf()).init()
+        net.fit(iter(batches))
+        assert net.iteration_count == 4
+
+    def test_regularization_increases_score(self, rng):
+        x, y = _toy_classification(rng)
+        plain = MultiLayerNetwork(_dense_conf()).init()
+        reg_conf = (NeuralNetConfiguration.builder().seed(42)
+                    .updater("adam").learning_rate(1e-2)
+                    .regularization(True).l2(0.5)
+                    .list()
+                    .layer(DenseLayer(n_out=32, activation="relu"))
+                    .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(10))
+                    .build())
+        reg = MultiLayerNetwork(reg_conf).init()
+        assert reg.score_for(x, y) > plain.score_for(x, y)
+
+    def test_gradient_normalization_clip_trains(self, rng):
+        x, y = _toy_classification(rng)
+        conf = (NeuralNetConfiguration.builder().seed(42)
+                .updater("sgd").learning_rate(0.1)
+                .gradient_normalization("clip_l2_per_layer", 1.0)
+                .list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(10))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        s0 = net.score_for(x, y)
+        for _ in range(40):
+            net.fit_batch(x, y)
+        assert net.score() < s0
+
+    def test_compute_gradient_and_score_shapes(self, rng):
+        x, y = _toy_classification(rng)
+        net = MultiLayerNetwork(_dense_conf()).init()
+        grads, score = net.compute_gradient_and_score(x, y)
+        assert score > 0
+        assert grads["layer_0"]["W"].shape == (10, 32)
+        assert grads["layer_1"]["b"].shape == (3,)
+
+
+class TestConvTraining:
+    def test_lenet_trains_and_bn_state_updates(self, rng):
+        x = rng.normal(size=(16, 28 * 28)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater("adam").learning_rate(1e-3).activation("relu")
+                .list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5)))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(BatchNormalization())
+                .layer(ConvolutionLayer(n_out=12, kernel_size=(5, 5)))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=32))
+                .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional_flat(28, 28, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        bn0 = np.asarray(net.state["layer_2"]["mean"]).copy()
+        s0 = net.score_for(x, y)
+        for _ in range(15):
+            net.fit_batch(x, y)
+        assert net.score() < s0
+        bn1 = np.asarray(net.state["layer_2"]["mean"])
+        assert not np.allclose(bn0, bn1)  # running stats moved
+        assert np.asarray(net.output(x)).shape == (16, 10)
+
+
+class TestRecurrentTraining:
+    def _lstm_conf(self, backprop_type="standard", tbptt=20):
+        return (NeuralNetConfiguration.builder().seed(3)
+                .updater("rmsprop").learning_rate(5e-3)
+                .list()
+                .layer(GravesLSTM(n_out=12, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(6))
+                .backprop_type(backprop_type)
+                .t_bptt_forward_length(tbptt)
+                .t_bptt_backward_length(tbptt)
+                .build())
+
+    def test_lstm_trains(self, rng):
+        x = rng.normal(size=(8, 12, 6)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (8, 12))]
+        net = MultiLayerNetwork(self._lstm_conf()).init()
+        s0 = net.score_for(x, y)
+        for _ in range(40):
+            net.fit_batch(x, y)
+        assert net.score() < s0
+
+    def test_tbptt_runs_and_trains(self, rng):
+        x = rng.normal(size=(4, 32, 6)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (4, 32))]
+        net = MultiLayerNetwork(self._lstm_conf("truncated_bptt", 8)).init()
+        s0 = net.score_for(x, y)
+        for _ in range(15):
+            net.fit_batch(x, y)
+        assert net.score() < s0
+
+    def test_rnn_time_step_matches_full_forward(self, rng):
+        x = rng.normal(size=(2, 5, 6)).astype(np.float32)
+        net = MultiLayerNetwork(self._lstm_conf()).init()
+        full = np.asarray(net.output(x))          # [2, 5, 4]
+        net.rnn_clear_previous_state()
+        stepped = np.stack(
+            [np.asarray(net.rnn_time_step(x[:, t, :])) for t in range(5)],
+            axis=1)
+        assert np.allclose(full, stepped, atol=1e-5)
+
+    def test_rnn_clear_state_resets(self, rng):
+        x = rng.normal(size=(2, 1, 6)).astype(np.float32)
+        net = MultiLayerNetwork(self._lstm_conf()).init()
+        a = np.asarray(net.rnn_time_step(x[:, 0, :]))
+        b = np.asarray(net.rnn_time_step(x[:, 0, :]))  # state carried -> differs
+        assert not np.allclose(a, b)
+        net.rnn_clear_previous_state()
+        c = np.asarray(net.rnn_time_step(x[:, 0, :]))
+        assert np.allclose(a, c, atol=1e-6)
+
+    def test_masked_sequences_train(self, rng):
+        x = rng.normal(size=(6, 10, 6)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (6, 10))]
+        mask = np.ones((6, 10), np.float32)
+        mask[:, 7:] = 0.0
+        net = MultiLayerNetwork(self._lstm_conf()).init()
+        s0 = net.score_for(x, y, mask=jnp.asarray(mask))
+        for _ in range(20):
+            net.fit_batch(x, y, mask=mask)
+        assert net.score() < s0
+
+
+class TestPerLayerOverrides:
+    def test_per_layer_lr_changes_updates(self, rng):
+        x, y = _toy_classification(rng)
+        conf = (NeuralNetConfiguration.builder().seed(42)
+                .updater("sgd").learning_rate(0.1)
+                .list()
+                .layer(DenseLayer(n_out=32, activation="relu", learning_rate=0.0))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(10))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(net.params["layer_0"]["W"]).copy()
+        out_w0 = np.asarray(net.params["layer_1"]["W"]).copy()
+        net.fit_batch(x, y)
+        assert np.allclose(w0, np.asarray(net.params["layer_0"]["W"]))  # frozen
+        assert not np.allclose(out_w0, np.asarray(net.params["layer_1"]["W"]))

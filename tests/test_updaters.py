@@ -1,0 +1,143 @@
+"""Updater/LR-schedule/grad-normalization tests.
+
+Parity model: reference updater tests (TestUpdaters.java) and
+LayerUpdater.java:132-226 schedule/normalization semantics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.training import TrainingConfig
+from deeplearning4j_tpu.optimize import (
+    apply_updates, learning_rate_at, make_updater, normalize_gradients)
+
+ALL_UPDATERS = ["sgd", "nesterovs", "adagrad", "rmsprop", "adadelta",
+                "adam", "adamax", "nadam"]
+
+
+@pytest.mark.parametrize("name", ALL_UPDATERS)
+def test_updater_minimizes_quadratic(name):
+    # adadelta ignores the LR (units-corrected rule); a larger epsilon keeps
+    # its early steps from being vanishingly small on this toy problem
+    t = TrainingConfig(updater=name,
+                       learning_rate=0.5 if name == "adagrad" else 0.1,
+                       epsilon=1e-2 if name == "adadelta" else 1e-8)
+    upd = make_updater(t)
+    params = {"layer_0": {"W": jnp.array([3.0, -2.0, 1.5])}}
+    state = upd.init(params)
+    for it in range(200):
+        grads = jax.tree_util.tree_map(lambda p: 2.0 * p, params)  # d/dp p^2
+        deltas, state = upd.update(grads, state, it)
+        params = apply_updates(params, deltas)
+    final = np.asarray(params["layer_0"]["W"])
+    assert np.all(np.abs(final) < 0.3), f"{name} did not converge: {final}"
+
+
+def test_sgd_exact_step():
+    t = TrainingConfig(updater="sgd", learning_rate=0.1)
+    upd = make_updater(t)
+    params = {"layer_0": {"W": jnp.array([1.0])}}
+    grads = {"layer_0": {"W": jnp.array([2.0])}}
+    deltas, _ = upd.update(grads, upd.init(params), 0)
+    new = apply_updates(params, deltas)
+    assert np.allclose(np.asarray(new["layer_0"]["W"]), [1.0 - 0.1 * 2.0])
+
+
+def test_none_updater_freezes_params():
+    t = TrainingConfig(updater="none", learning_rate=0.1)
+    upd = make_updater(t)
+    params = {"layer_0": {"W": jnp.array([1.0])}}
+    grads = {"layer_0": {"W": jnp.array([5.0])}}
+    deltas, _ = upd.update(grads, upd.init(params), 0)
+    assert np.allclose(np.asarray(deltas["layer_0"]["W"]), [0.0])
+
+
+def test_lr_multipliers_scale_updates():
+    t = TrainingConfig(updater="sgd", learning_rate=0.1)
+    mults = {"layer_0": {"W": 1.0, "b": 2.0}}
+    upd = make_updater(t, mults)
+    params = {"layer_0": {"W": jnp.array([1.0]), "b": jnp.array([1.0])}}
+    grads = {"layer_0": {"W": jnp.array([1.0]), "b": jnp.array([1.0])}}
+    deltas, _ = upd.update(grads, upd.init(params), 0)
+    assert np.allclose(np.asarray(deltas["layer_0"]["b"]),
+                       2.0 * np.asarray(deltas["layer_0"]["W"]))
+
+
+class TestSchedules:
+    def test_exponential(self):
+        t = TrainingConfig(learning_rate=1.0, lr_policy="exponential",
+                           lr_policy_decay_rate=0.5)
+        assert float(learning_rate_at(t, 0)) == pytest.approx(1.0)
+        assert float(learning_rate_at(t, 2)) == pytest.approx(0.25)
+
+    def test_inverse(self):
+        t = TrainingConfig(learning_rate=1.0, lr_policy="inverse",
+                           lr_policy_decay_rate=1.0, lr_policy_power=2.0)
+        assert float(learning_rate_at(t, 1)) == pytest.approx(0.25)
+
+    def test_step(self):
+        t = TrainingConfig(learning_rate=1.0, lr_policy="step",
+                           lr_policy_decay_rate=0.1, lr_policy_steps=10)
+        assert float(learning_rate_at(t, 9)) == pytest.approx(1.0)
+        assert float(learning_rate_at(t, 10)) == pytest.approx(0.1, rel=1e-4)
+        assert float(learning_rate_at(t, 25)) == pytest.approx(0.01, rel=1e-4)
+
+    def test_poly(self):
+        t = TrainingConfig(learning_rate=1.0, lr_policy="poly",
+                           lr_policy_steps=100, lr_policy_power=1.0)
+        assert float(learning_rate_at(t, 50)) == pytest.approx(0.5)
+        assert float(learning_rate_at(t, 100)) == pytest.approx(0.0)
+
+    def test_schedule_map(self):
+        t = TrainingConfig(learning_rate=1.0, lr_policy="schedule",
+                           lr_schedule={5: 0.5, 10: 0.25})
+        assert float(learning_rate_at(t, 4)) == pytest.approx(1.0)
+        assert float(learning_rate_at(t, 5)) == pytest.approx(0.5)
+        assert float(learning_rate_at(t, 11)) == pytest.approx(0.25)
+
+    def test_schedule_is_jittable(self):
+        t = TrainingConfig(learning_rate=1.0, lr_policy="step",
+                           lr_policy_decay_rate=0.5, lr_policy_steps=2)
+        f = jax.jit(lambda it: learning_rate_at(t, it))
+        assert float(f(jnp.asarray(4))) == pytest.approx(0.25)
+
+
+class TestGradNormalization:
+    grads = {"layer_0": {"W": jnp.array([3.0, 4.0]), "b": jnp.array([12.0])}}
+
+    def test_renormalize_l2_per_layer(self):
+        out = normalize_gradients(self.grads, "renormalize_l2_per_layer")
+        n = np.sqrt(9 + 16 + 144)
+        assert np.allclose(np.asarray(out["layer_0"]["W"]), [3 / n, 4 / n])
+
+    def test_renormalize_l2_per_param_type(self):
+        out = normalize_gradients(self.grads, "renormalize_l2_per_param_type")
+        assert np.allclose(np.asarray(out["layer_0"]["W"]), [0.6, 0.8])
+        assert np.allclose(np.asarray(out["layer_0"]["b"]), [1.0])
+
+    def test_clip_elementwise(self):
+        out = normalize_gradients(self.grads,
+                                  "clip_elementwise_absolute_value", 3.5)
+        assert np.allclose(np.asarray(out["layer_0"]["W"]), [3.0, 3.5])
+        assert np.allclose(np.asarray(out["layer_0"]["b"]), [3.5])
+
+    def test_clip_l2_per_layer(self):
+        out = normalize_gradients(self.grads, "clip_l2_per_layer", 1.0)
+        n = np.sqrt(9 + 16 + 144)
+        assert np.allclose(np.asarray(out["layer_0"]["W"]),
+                           [3 / n, 4 / n], atol=1e-6)
+        # below-threshold layers untouched
+        small = {"layer_0": {"W": jnp.array([0.1])}}
+        out2 = normalize_gradients(small, "clip_l2_per_layer", 1.0)
+        assert np.allclose(np.asarray(out2["layer_0"]["W"]), [0.1])
+
+    def test_clip_l2_per_param_type(self):
+        out = normalize_gradients(self.grads, "clip_l2_per_param_type", 5.0)
+        assert np.allclose(np.asarray(out["layer_0"]["W"]), [3.0, 4.0])
+        assert np.allclose(np.asarray(out["layer_0"]["b"]), [5.0])
+
+    def test_none_passthrough(self):
+        out = normalize_gradients(self.grads, None)
+        assert out is self.grads
