@@ -1,0 +1,40 @@
+"""Data pipeline: DataSet container, iterator framework, canned datasets.
+
+Parity: reference ``deeplearning4j-core/.../datasets/`` (fetchers:
+``MnistDataFetcher.java``, ``IrisDataFetcher.java``; iterators:
+``MnistDataSetIterator.java``, ``IrisDataSetIterator.java``) and
+``deeplearning4j-nn/.../datasets/iterator/`` (``AsyncDataSetIterator.java:36``,
+``BaseDatasetIterator``, ``MultipleEpochsIterator``, ``SamplingDataSetIterator``,
+``ListDataSetIterator``).
+
+TPU-native design: iterators yield host numpy batches; ``AsyncDataSetIterator``
+overlaps host-side batch assembly and host→device transfer with device compute
+via a background thread + ``jax.device_put`` double-buffering — the analog of
+the reference's prefetch thread with device affinity
+(``AsyncDataSetIterator.java:75-76``).
+"""
+
+from .dataset import DataSet
+from .iterator import (
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from .fetchers import IrisDataSetIterator, MnistDataSetIterator
+
+__all__ = [
+    "DataSet",
+    "DataSetIterator",
+    "ArrayDataSetIterator",
+    "ListDataSetIterator",
+    "ExistingDataSetIterator",
+    "MultipleEpochsIterator",
+    "SamplingDataSetIterator",
+    "AsyncDataSetIterator",
+    "MnistDataSetIterator",
+    "IrisDataSetIterator",
+]

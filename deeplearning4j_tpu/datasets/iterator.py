@@ -1,0 +1,280 @@
+"""DataSetIterator framework + async device prefetch.
+
+Parity: reference ``deeplearning4j-nn/.../datasets/iterator/`` —
+``DataSetIterator`` contract, ``ListDataSetIterator``, ``ExistingDataSetIterator``,
+``MultipleEpochsIterator``, ``SamplingDataSetIterator``, and
+``AsyncDataSetIterator.java:36`` (background ``IteratorRunnable`` thread +
+``LinkedBlockingQueue``).
+
+TPU-native: ``AsyncDataSetIterator`` additionally issues ``jax.device_put`` on
+the background thread so host→HBM DMA overlaps the previous step's compute —
+the role the reference's device-affinity prefetch played for GPUs.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from .dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator contract (parity: ND4J ``DataSetIterator``).
+
+    Subclasses implement ``next()`` / ``has_next()`` / ``reset()``.
+    Iterating with ``for`` restarts from the current cursor; call ``reset()``
+    for a fresh epoch (``MultiLayerNetwork.fit`` resets between epochs).
+    """
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[DataSet]:
+        while self.has_next():
+            yield self.next()
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batches over in-memory arrays (parity: ``INDArrayDataSetIterator``)."""
+
+    def __init__(self, features, labels, batch_size: int,
+                 features_mask=None, labels_mask=None):
+        self._data = DataSet(features, labels, features_mask, labels_mask)
+        self._batch = int(batch_size)
+        self._cursor = 0
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return self._data.num_examples()
+
+    def has_next(self) -> bool:
+        return self._cursor < self._data.num_examples()
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        end = min(self._cursor + self._batch, self._data.num_examples())
+        out = self._data._take(slice(self._cursor, end))
+        self._cursor = end
+        return out
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        self._data.shuffle(seed)
+        self._cursor = 0
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterator over a pre-batched list (parity: ``ListDataSetIterator``)."""
+
+    def __init__(self, datasets: Iterable[DataSet], batch_size: Optional[int] = None):
+        self._list: List[DataSet] = list(datasets)
+        self._batch = batch_size or (self._list[0].num_examples() if self._list else 0)
+        self._cursor = 0
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch
+
+    def has_next(self) -> bool:
+        return self._cursor < len(self._list)
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        out = self._list[self._cursor]
+        self._cursor += 1
+        return out
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap a plain python iterable of DataSets (parity:
+    ``ExistingDataSetIterator``). Resettable only if the source is re-iterable."""
+
+    def __init__(self, source: Iterable[DataSet]):
+        self._source = source
+        self._iter = iter(source)
+        self._peek: Optional[DataSet] = None
+
+    @property
+    def batch_size(self) -> int:
+        return -1
+
+    def has_next(self) -> bool:
+        if self._peek is None:
+            try:
+                self._peek = next(self._iter)
+            except StopIteration:
+                return False
+        return True
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        out, self._peek = self._peek, None
+        return out
+
+    def reset(self) -> None:
+        self._iter = iter(self._source)
+        self._peek = None
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat an underlying iterator N times (parity: ``MultipleEpochsIterator``)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = int(epochs)
+        self.base = base
+        self._epoch = 0
+
+    @property
+    def batch_size(self) -> int:
+        return self.base.batch_size
+
+    def has_next(self) -> bool:
+        if self.base.has_next():
+            return True
+        if self._epoch + 1 < self.epochs:
+            self._epoch += 1
+            self.base.reset()
+            return self.base.has_next()
+        return False
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.base.next()
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self.base.reset()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random with-replacement samples from one DataSet (parity:
+    ``SamplingDataSetIterator``)."""
+
+    def __init__(self, data: DataSet, batch_size: int, total_batches: int,
+                 seed: Optional[int] = None):
+        self._data = data
+        self._batch = int(batch_size)
+        self._total = int(total_batches)
+        self._seed = seed
+        self._rng = np.random.default_rng(seed)
+        self._count = 0
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch
+
+    def has_next(self) -> bool:
+        return self._count < self._total
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        self._count += 1
+        idx = self._rng.choice(self._data.num_examples(), size=self._batch,
+                               replace=True)
+        return self._data._take(idx)
+
+    def reset(self) -> None:
+        self._count = 0
+        self._rng = np.random.default_rng(self._seed)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch + optional device placement.
+
+    Parity: ``AsyncDataSetIterator.java:36`` — a producer thread drains the
+    base iterator into a bounded queue while the training loop consumes.
+    With ``device_put=True`` the producer also ships each batch to the
+    device so the next step's HBM transfer overlaps the current step.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 2,
+                 device_put: bool = False, device=None):
+        self.base = base
+        self.queue_size = max(1, int(queue_size))
+        self.device_put = device_put
+        self.device = device
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._peek = None
+        self._start()
+
+    def _producer(self) -> None:
+        try:
+            for ds in self.base:
+                if self.device_put:
+                    import jax
+                    ds = DataSet(
+                        jax.device_put(ds.features, self.device),
+                        jax.device_put(ds.labels, self.device),
+                        None if ds.features_mask is None
+                        else jax.device_put(ds.features_mask, self.device),
+                        None if ds.labels_mask is None
+                        else jax.device_put(ds.labels_mask, self.device))
+                self._queue.put(ds)
+        except BaseException as e:  # surfaced on the consumer side
+            self._error = e
+        finally:
+            self._queue.put(self._SENTINEL)
+
+    def _start(self) -> None:
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    @property
+    def batch_size(self) -> int:
+        return self.base.batch_size
+
+    def has_next(self) -> bool:
+        if self._peek is None:
+            self._peek = self._queue.get()
+        if self._peek is self._SENTINEL:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            return False
+        return True
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        out, self._peek = self._peek, None
+        return out
+
+    def reset(self) -> None:
+        # drain the running producer fully, then restart on a reset base
+        while self.has_next():
+            self.next()
+        self._peek = None
+        self.base.reset()
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._start()

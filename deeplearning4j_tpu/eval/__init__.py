@@ -1,0 +1,26 @@
+"""Evaluation: classification / regression / ROC metrics.
+
+Parity: reference ``deeplearning4j-nn/src/main/java/org/deeplearning4j/eval/``
+— ``Evaluation.java:410`` (``stats()``), ``:483/:531/:703``
+(precision/recall/f1), ``ConfusionMatrix.java``, ``RegressionEvaluation.java``,
+``ROC.java``.
+
+TPU-native design: metric *accumulation* happens on host in numpy (cheap,
+O(batch) counters); the expensive part — the forward pass producing the
+predictions — stays a compiled XLA program on device. This mirrors how the
+reference streams ``Evaluation.eval(labels, out)`` per minibatch but replaces
+INDArray bookkeeping with numpy.
+"""
+
+from .confusion import ConfusionMatrix
+from .evaluation import Evaluation
+from .regression import RegressionEvaluation
+from .roc import ROC, ROCMultiClass
+
+__all__ = [
+    "ConfusionMatrix",
+    "Evaluation",
+    "RegressionEvaluation",
+    "ROC",
+    "ROCMultiClass",
+]

@@ -1,0 +1,194 @@
+"""Classification evaluation (parity: reference ``eval/Evaluation.java``).
+
+Accumulates a confusion matrix from streamed minibatches and derives
+accuracy / per-class precision / recall / F1 plus macro averages, matching
+``Evaluation.java:410`` (``stats()``), ``:483`` (``precision``), ``:531``
+(``recall``), ``:703`` (``f1``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .confusion import ConfusionMatrix
+
+
+def _to_class_indices(arr: np.ndarray) -> np.ndarray:
+    """Labels/predictions may be one-hot/probabilities [b, c] (or [b, c, t]
+    time series) or already class indices [b]."""
+    arr = np.asarray(arr)
+    if arr.ndim == 1:
+        return arr.astype(np.int64)
+    return np.argmax(arr, axis=-1).reshape(-1)
+
+
+class Evaluation:
+    """Streaming classification metrics.
+
+    Usage::
+
+        ev = Evaluation()
+        for x, y in batches:
+            ev.eval(y, net.output(x))
+        print(ev.stats())
+    """
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[Sequence[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = list(labels) if labels is not None else None
+        self.confusion: Optional[ConfusionMatrix] = None
+        self._examples = 0
+
+    # -- accumulation ---------------------------------------------------
+
+    def _ensure_confusion(self, n: int) -> None:
+        if self.confusion is None:
+            size = self.num_classes or n
+            self.confusion = ConfusionMatrix(range(size))
+            self.num_classes = size
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        """Accumulate one minibatch.
+
+        labels: one-hot [b, c] (or [b, t, c] time series) or ints [b];
+        predictions: probabilities, same leading shape; mask: optional
+        per-row [b] / per-timestep [b, t] 0/1 array — masked rows are
+        excluded (parity: ``Evaluation.evalTimeSeries`` masking).
+        """
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim > 1:
+            n_out = labels.shape[-1]
+        else:
+            # integer class indices: size from labels, plus predictions only
+            # when those are indices too (not a probability matrix)
+            n_out = int(labels.max(initial=0)) + 1
+            if predictions.ndim == 1:
+                n_out = max(n_out, int(predictions.max(initial=0)) + 1)
+            else:
+                n_out = max(n_out, predictions.shape[-1])
+        self._ensure_confusion(n_out)
+        if n_out > len(self.confusion.classes):
+            # a later batch revealed new classes (int-label streams)
+            self.confusion.grow_to(n_out)
+            self.num_classes = n_out
+
+        if labels.ndim == 3:  # [b, t, c] time series → flatten active steps
+            b, t, c = labels.shape
+            labels2 = labels.reshape(b * t, c)
+            preds2 = predictions.reshape(b * t, c)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(b * t) > 0
+                labels2, preds2 = labels2[keep], preds2[keep]
+            y_true = _to_class_indices(labels2)
+            y_pred = _to_class_indices(preds2)
+        else:
+            y_true = _to_class_indices(labels)
+            y_pred = _to_class_indices(predictions)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                y_true, y_pred = y_true[keep], y_pred[keep]
+
+        self.confusion.add_batch(y_true, y_pred)
+        self._examples += len(y_true)
+
+    def merge(self, other: "Evaluation") -> None:
+        """Combine evaluations from parallel workers (parity: the Spark
+        ``EvaluationReduceFunction``)."""
+        if other.confusion is None:
+            return
+        if self.confusion is None:
+            self.confusion = ConfusionMatrix(other.confusion.classes)
+            self.num_classes = other.num_classes
+        self.confusion.merge(other.confusion)
+        self._examples += other._examples
+
+    # -- per-class counts ----------------------------------------------
+
+    def true_positives(self, cls: int) -> int:
+        return self.confusion.count(cls, cls)
+
+    def false_positives(self, cls: int) -> int:
+        return self.confusion.predicted_total(cls) - self.true_positives(cls)
+
+    def false_negatives(self, cls: int) -> int:
+        return self.confusion.actual_total(cls) - self.true_positives(cls)
+
+    def true_negatives(self, cls: int) -> int:
+        return (self.confusion.total() - self.true_positives(cls)
+                - self.false_positives(cls) - self.false_negatives(cls))
+
+    # -- metrics --------------------------------------------------------
+
+    def accuracy(self) -> float:
+        total = self.confusion.total()
+        if total == 0:
+            return 0.0
+        return float(np.trace(self.confusion.matrix)) / total
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            denom = self.confusion.predicted_total(cls)
+            return self.true_positives(cls) / denom if denom else 0.0
+        vals = [self.precision(c) for c in self._seen_classes()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            denom = self.confusion.actual_total(cls)
+            return self.true_positives(cls) / denom if denom else 0.0
+        vals = [self.recall(c) for c in self._seen_classes()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        vals = [self.f1(c) for c in self._seen_classes()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def _seen_classes(self) -> List[int]:
+        """Classes that actually appear (as truth or prediction) — macro
+        averages over absent classes would deflate scores, matching the
+        reference's treatment of classes with no examples."""
+        if self.confusion is None:
+            return []
+        seen = (self.confusion.matrix.sum(axis=0)
+                + self.confusion.matrix.sum(axis=1)) > 0
+        return [c for c, s in zip(self.confusion.classes, seen) if s]
+
+    def num_examples(self) -> int:
+        return self._examples
+
+    def _label(self, c: int) -> str:
+        if self.label_names and c < len(self.label_names):
+            return self.label_names[c]
+        return str(c)
+
+    def stats(self) -> str:
+        """Human-readable report (parity: ``Evaluation.stats()`` :410)."""
+        if self.confusion is None:
+            return "Evaluation: no data"
+        lines = ["========================Evaluation========================="]
+        lines.append(f" Examples:  {self._examples}")
+        lines.append(f" Accuracy:  {self.accuracy():.4f}")
+        lines.append(f" Precision: {self.precision():.4f}")
+        lines.append(f" Recall:    {self.recall():.4f}")
+        lines.append(f" F1 Score:  {self.f1():.4f}")
+        lines.append("-----------------------------------------------------------")
+        lines.append(" Per class:  class  precision  recall  f1  support")
+        for c in self._seen_classes():
+            lines.append(
+                f"   {self._label(c):>8}  {self.precision(c):.4f}  "
+                f"{self.recall(c):.4f}  {self.f1(c):.4f}  "
+                f"{self.confusion.actual_total(c)}")
+        lines.append("-----------------------------------------------------------")
+        lines.append("Confusion matrix (rows=actual, cols=predicted):")
+        lines.append(str(self.confusion))
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.stats()

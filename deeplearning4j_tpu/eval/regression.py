@@ -1,0 +1,134 @@
+"""Regression evaluation (parity: reference ``eval/RegressionEvaluation.java``).
+
+Per-column MSE / MAE / RMSE / RSE / R² (correlation²) accumulated in a
+streaming, numerically-stable way (sum / sum-of-squares / cross moments), so
+it can be merged across data-parallel workers exactly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class RegressionEvaluation:
+    def __init__(self, n_columns: Optional[int] = None,
+                 column_names: Optional[Sequence[str]] = None):
+        self.n_columns = n_columns
+        self.column_names = list(column_names) if column_names else None
+        self._initialized = False
+
+    def _init_accum(self, n: int) -> None:
+        self.n_columns = n
+        z = lambda: np.zeros(n, dtype=np.float64)
+        self._count = z()
+        self._sum_abs_err = z()
+        self._sum_sq_err = z()
+        self._sum_label = z()
+        self._sum_pred = z()
+        self._sum_label_sq = z()
+        self._sum_pred_sq = z()
+        self._sum_label_pred = z()
+        self._initialized = True
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels, dtype=np.float64)
+        predictions = np.asarray(predictions, dtype=np.float64)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            predictions = predictions[:, None]
+        if labels.ndim == 3:  # [b, t, c] → flatten time
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                labels, predictions = labels[keep], predictions[keep]
+        elif mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[keep], predictions[keep]
+        if not self._initialized:
+            self._init_accum(labels.shape[1])
+        err = predictions - labels
+        self._count += labels.shape[0]
+        self._sum_abs_err += np.abs(err).sum(axis=0)
+        self._sum_sq_err += (err ** 2).sum(axis=0)
+        self._sum_label += labels.sum(axis=0)
+        self._sum_pred += predictions.sum(axis=0)
+        self._sum_label_sq += (labels ** 2).sum(axis=0)
+        self._sum_pred_sq += (predictions ** 2).sum(axis=0)
+        self._sum_label_pred += (labels * predictions).sum(axis=0)
+
+    def merge(self, other: "RegressionEvaluation") -> None:
+        if not other._initialized:
+            return
+        if not self._initialized:
+            self._init_accum(other.n_columns)
+        for attr in ("_count", "_sum_abs_err", "_sum_sq_err", "_sum_label",
+                     "_sum_pred", "_sum_label_sq", "_sum_pred_sq",
+                     "_sum_label_pred"):
+            setattr(self, attr, getattr(self, attr) + getattr(other, attr))
+
+    # -- per-column metrics --------------------------------------------
+
+    def mean_squared_error(self, col: int) -> float:
+        return float(self._sum_sq_err[col] / max(self._count[col], 1))
+
+    def mean_absolute_error(self, col: int) -> float:
+        return float(self._sum_abs_err[col] / max(self._count[col], 1))
+
+    def root_mean_squared_error(self, col: int) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def correlation_r2(self, col: int) -> float:
+        """Squared Pearson correlation (the reference's correlationR2)."""
+        n = self._count[col]
+        if n == 0:
+            return 0.0
+        cov = self._sum_label_pred[col] - self._sum_label[col] * self._sum_pred[col] / n
+        var_l = self._sum_label_sq[col] - self._sum_label[col] ** 2 / n
+        var_p = self._sum_pred_sq[col] - self._sum_pred[col] ** 2 / n
+        denom = var_l * var_p
+        return float(cov * cov / denom) if denom > 0 else 0.0
+
+    def relative_squared_error(self, col: int) -> float:
+        n = self._count[col]
+        if n == 0:
+            return 0.0
+        var_l = self._sum_label_sq[col] - self._sum_label[col] ** 2 / n
+        return float(self._sum_sq_err[col] / var_l) if var_l > 0 else 0.0
+
+    # -- aggregates -----------------------------------------------------
+
+    def average_mean_squared_error(self) -> float:
+        return float(np.mean([self.mean_squared_error(i) for i in range(self.n_columns)]))
+
+    def average_mean_absolute_error(self) -> float:
+        return float(np.mean([self.mean_absolute_error(i) for i in range(self.n_columns)]))
+
+    def average_root_mean_squared_error(self) -> float:
+        return float(np.mean([self.root_mean_squared_error(i) for i in range(self.n_columns)]))
+
+    def average_correlation_r2(self) -> float:
+        return float(np.mean([self.correlation_r2(i) for i in range(self.n_columns)]))
+
+    def _name(self, i: int) -> str:
+        if self.column_names and i < len(self.column_names):
+            return self.column_names[i]
+        return f"col_{i}"
+
+    def stats(self) -> str:
+        if not self._initialized:
+            return "RegressionEvaluation: no data"
+        lines = ["Column        MSE          MAE          RMSE         RSE          R^2"]
+        for i in range(self.n_columns):
+            lines.append(
+                f"{self._name(i):<12} {self.mean_squared_error(i):<12.6g} "
+                f"{self.mean_absolute_error(i):<12.6g} "
+                f"{self.root_mean_squared_error(i):<12.6g} "
+                f"{self.relative_squared_error(i):<12.6g} "
+                f"{self.correlation_r2(i):<12.6g}")
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.stats()

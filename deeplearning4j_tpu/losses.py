@@ -167,27 +167,34 @@ def score_array(loss_name: str, labels, pre_output, activation: str,
     return jnp.sum(per_elem, axis=axes) if axes else per_elem
 
 
-def score(loss_name: str, labels, pre_output, activation: str,
-          mask: Optional[jax.Array] = None, average: bool = True) -> jax.Array:
-    """Scalar loss. With a mask, averaging divides by the active row count
-    (parity with reference masked-score semantics in BaseOutputLayer).
-
-    Explicit mask-kind contract (replaces shape-coincidence guessing):
+def masked_denominator(mask: Optional[jax.Array], labels,
+                       batch_size: int) -> jax.Array:
+    """The averaging denominator under the explicit mask-kind contract
+    (single source of truth — used by both :func:`score` and the network
+    runtime's loss):
+      - mask is None — the batch size.
       - mask.ndim <  labels.ndim — a per-row mask ([b] or [b,t]); each entry
         covers one example/timestep, so the denominator is ``sum(mask)``.
       - mask.ndim == labels.ndim — a per-output mask; a row counts as active
         if ANY of its outputs is unmasked, so the denominator is
         ``sum(any(mask, axis=-1))``.
     """
+    if mask is None:
+        return jnp.float32(batch_size)
+    if mask.ndim == labels.ndim:               # per-output mask
+        row_active = jnp.max(mask, axis=-1)
+        return jnp.maximum(jnp.sum(row_active), 1.0)
+    return jnp.maximum(jnp.sum(mask), 1.0)     # per-row (example/timestep)
+
+
+def score(loss_name: str, labels, pre_output, activation: str,
+          mask: Optional[jax.Array] = None, average: bool = True) -> jax.Array:
+    """Scalar loss. With a mask, averaging divides by the active row count
+    (parity with reference masked-score semantics in BaseOutputLayer);
+    see :func:`masked_denominator` for the mask-kind contract.
+    """
     arr = score_array(loss_name, labels, pre_output, activation, mask)
     total = jnp.sum(arr)
     if not average:
         return total
-    if mask is not None and mask.ndim >= 1:
-        if mask.ndim == labels.ndim:           # per-output mask
-            row_active = jnp.max(mask, axis=-1)
-            denom = jnp.maximum(jnp.sum(row_active), 1.0)
-        else:                                   # per-row (example/timestep) mask
-            denom = jnp.maximum(jnp.sum(mask), 1.0)
-        return total / denom
-    return total / labels.shape[0]
+    return total / masked_denominator(mask, labels, labels.shape[0])

@@ -372,6 +372,12 @@ class ConvolutionLayer(Layer):
     border_mode: Optional[str] = None   # None=explicit pad | "same" | "valid"
     groups: int = 1
 
+    def __post_init__(self):
+        # ergonomic: padding="same"/"valid" routes to border_mode
+        if isinstance(self.padding, str):
+            self.border_mode = self.padding
+            self.padding = (0, 0)
+
     def _pad_arg(self):
         if self.border_mode:
             return self.border_mode

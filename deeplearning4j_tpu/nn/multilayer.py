@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import dtypes as _dtypes
+from .. import losses as _losses
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
 from .conf.multi_layer import MultiLayerConfiguration
@@ -102,6 +103,18 @@ class MultiLayerNetwork:
             layer_lr = layer.learning_rate if layer.learning_rate is not None else base
             bias_lr = (layer.bias_learning_rate
                        if layer.bias_learning_rate is not None else layer_lr)
+            if base == 0.0:
+                # frozen net: any per-layer override would be silently scaled
+                # to 0 through the multiplier — reject it loudly
+                if layer_lr != 0.0 or bias_lr != 0.0:
+                    raise ValueError(
+                        f"layer {i} sets learning_rate={layer_lr}/"
+                        f"bias_learning_rate={bias_lr} but the global "
+                        "learning_rate is 0.0; per-layer overrides are "
+                        "expressed as multiples of the global rate")
+                mults[_layer_key(i)] = {
+                    name: 1.0 for name in layer.param_shapes(self.policy)}
+                continue
             mults[_layer_key(i)] = {
                 name: (bias_lr / base if name == "b" else layer_lr / base)
                 for name in layer.param_shapes(self.policy)
@@ -176,16 +189,22 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
 
     def output(self, x, train: bool = False):
-        """Final-layer activations (compiled; cached across calls)."""
+        """Final-layer activations (compiled; cached per train/eval mode).
+        train=True runs train-mode forward semantics (dropout active, BN
+        batch statistics) without updating parameters."""
         x = jnp.asarray(x)
-        fn = self._jit_cache.get("output")
+        cache_key = f"output_train={train}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             @jax.jit
-            def fn(params, states, x):
-                out, _ = self._forward(params, states, x, train=False)
+            def fn(params, states, x, rng):
+                out, _ = self._forward(params, states, x, train=train,
+                                       rng=rng if train else None)
                 return out
-            self._jit_cache["output"] = fn
-        return fn(self.params, self._states_list(), x)
+            self._jit_cache[cache_key] = fn
+        rng = _rng.fold_name(_rng.key(self.training.seed),
+                             f"output_{self.iteration_count}") if train else None
+        return fn(self.params, self._states_list(), x, rng)
 
     def feed_forward(self, x, train: bool = False) -> List[jax.Array]:
         """All layer activations, input first (parity: feedForward :627)."""
@@ -219,6 +238,8 @@ class MultiLayerNetwork:
         matching the reference's update)."""
         if not self.training.regularization:
             return 0.0
+        acc_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
+                     else jnp.float32)
         total = 0.0
         for i, layer in enumerate(self.layers):
             l1 = float(layer.l1 or 0.0)
@@ -229,7 +250,7 @@ class MultiLayerNetwork:
             for name in layer.regularized_params():
                 if name not in lp:
                     continue
-                w = lp[name].astype(jnp.float32)
+                w = lp[name].astype(acc_dtype)
                 if l1:
                     total = total + l1 * jnp.sum(jnp.abs(w))
                 if l2:
@@ -254,18 +275,14 @@ class MultiLayerNetwork:
         score_arr = out_layer.compute_score_array(
             params[_layer_key(out_idx)], hidden, y, mask=out_mask,
             policy=self.policy)
-        # denominator follows the explicit mask contract of losses.score:
-        # per-row masks divide by the active row/timestep count, per-output
-        # masks by rows with any active output; unmasked by batch size.
-        if out_mask is None:
-            denom = float(score_arr.shape[0])
-        elif out_mask.ndim == y.ndim:
-            denom = jnp.maximum(jnp.sum(jnp.max(out_mask, axis=-1)), 1.0)
-        else:
-            denom = jnp.maximum(jnp.sum(out_mask), 1.0)
+        denom = _losses.masked_denominator(out_mask, y, score_arr.shape[0])
         loss = jnp.sum(score_arr) / denom
         loss = loss + self._reg_penalty(params)
-        return loss.astype(jnp.float32), new_states
+        # keep full precision under a float64 policy (gradient checking);
+        # float32 otherwise (bf16 losses are too coarse for LR-sized steps)
+        loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
+                      else jnp.float32)
+        return loss.astype(loss_dtype), new_states
 
     def score_for(self, x, y, mask=None) -> float:
         """Loss on a batch without updating (parity: score via
@@ -276,7 +293,12 @@ class MultiLayerNetwork:
         return float(loss)
 
     def score(self) -> Optional[float]:
-        """Score from the most recent fit iteration (parity: score() :1900)."""
+        """Score from the most recent fit iteration (parity: score() :1900).
+        Lazily syncs: the fit loop keeps the loss on device so step dispatch
+        pipelines; the device→host transfer happens here, on demand."""
+        if self._score is None:
+            return None
+        self._score = float(self._score)
         return self._score
 
     def compute_gradient_and_score(self, x, y, mask=None):
@@ -313,6 +335,69 @@ class MultiLayerNetwork:
             fn = self._make_train_step()
             self._jit_cache["train_step"] = fn
         return fn
+
+    def _make_train_scan(self):
+        """K train steps fused into ONE XLA program via lax.scan — the
+        idiomatic TPU inner loop: no per-step host dispatch, the whole
+        sequence of updates runs on-chip. Used by fit_scan()."""
+        t = self.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = self._updater
+
+        def one(carry, batch):
+            params, opt_state, states, it = carry
+            x, y, mask, rng = batch
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, it)
+            params = _updaters.apply_updates(params, deltas)
+            # carry structure must stay fixed: keep exactly the persistent
+            # state keys (BN stats); transient rnn carry (h/c) resets per batch
+            kept = [
+                {k: new_states[i].get(k, v) for k, v in st_old.items()}
+                for i, st_old in enumerate(states)]
+            return (params, opt_state, kept, it + 1), loss
+
+        def scan_steps(params, opt_state, states, xs, ys, masks, rngs, it0):
+            (params, opt_state, states, _), losses = jax.lax.scan(
+                one, (params, opt_state, states, it0), (xs, ys, masks, rngs))
+            return params, opt_state, states, losses
+
+        return jax.jit(scan_steps, donate_argnums=(0, 1))
+
+    def fit_scan(self, xs, ys, masks=None):
+        """Train on K pre-staged batches in one device dispatch.
+
+        xs: [k, b, ...], ys: [k, b, ...], masks: optional [k, ...].
+        Returns the per-step losses (device array, shape [k]).
+        """
+        xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+        k = xs.shape[0]
+        if masks is not None:
+            masks = jnp.asarray(masks)
+        fn = self._jit_cache.get("train_scan")
+        if fn is None:
+            fn = self._make_train_scan()
+            self._jit_cache["train_scan"] = fn
+        base = _rng.key(self.training.seed)
+        rngs = jax.vmap(
+            lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(self._update_count, self._update_count + k))
+        it0 = jnp.asarray(self._update_count, jnp.int32)
+        states = self._states_list()
+        params, opt_state, new_states, losses = fn(
+            self.params, self.updater_state, states, xs, ys, masks, rngs, it0)
+        self.params = params
+        self.updater_state = opt_state
+        self._update_count += k
+        self._persist_states(new_states)
+        self._score = losses[-1]
+        self.iteration_count += k
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration_count, losses[-1])
+        return losses
 
     # ------------------------------------------------------------------
     # fit (parity: fit(DataSetIterator) :1037, doTruncatedBPTT :1079)
@@ -358,6 +443,14 @@ class MultiLayerNetwork:
         if hasattr(data, "features"):
             yield (data.features, data.labels,
                    getattr(data, "features_mask", None))
+            return
+        # the documented fit((features, labels)) tuple form: a 2/3-tuple of
+        # arrays is ONE batch, not an iterator of batches
+        if (isinstance(data, tuple) and len(data) in (2, 3)
+                and all(hasattr(a, "shape") or a is None for a in data)):
+            x, y = data[0], data[1]
+            m = data[2] if len(data) > 2 else mask
+            yield (x, y, m)
             return
         for item in data:
             if hasattr(item, "features"):
@@ -424,8 +517,10 @@ class MultiLayerNetwork:
         self._last_rnn_carry = jax.tree_util.tree_map(
             jax.lax.stop_gradient, self._extract_rnn_carry(new_states))
         self._persist_states(new_states)
-        self._score = float(loss)
-        return self._score
+        # keep the loss on device — no host sync in the hot loop; score()
+        # and listeners that read it pay the transfer lazily
+        self._score = loss
+        return loss
 
     def _fire_iteration(self, batch_size, loss):
         self.iteration_count += 1
@@ -454,7 +549,9 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
 
     def clone_params(self):
-        return jax.tree_util.tree_map(lambda p: p, self.params)
+        """Deep copy — the train step donates the live param buffers, so an
+        aliasing 'clone' would be invalidated by the next fit_batch."""
+        return jax.tree_util.tree_map(lambda p: jnp.array(p), self.params)
 
     def set_params(self, params) -> None:
         self.params = params

@@ -1,0 +1,28 @@
+"""Distributed training over TPU meshes.
+
+Parity: reference single-node multi-device ``ParallelWrapper``
+(``deeplearning4j-core/.../parallelism/ParallelWrapper.java:37-204``) and the
+Spark ``ParameterAveragingTrainingMaster``
+(``dl4j-spark/.../impl/paramavg/ParameterAveragingTrainingMaster.java:340``).
+
+TPU-native design — two modes, both expressed as XLA SPMD programs over a
+``jax.sharding.Mesh`` (no worker threads, no parameter shipping over TCP):
+
+- **sync** (default, ``averaging_frequency=1``): ONE jitted train step with the
+  batch sharded over the ``data`` mesh axis and params replicated. XLA inserts
+  the gradient all-reduce over ICI automatically. This is strictly stronger
+  than the reference's averaging-every-N (equivalent to N=1 at far lower
+  cost than its param shipping).
+- **local-SGD** (``averaging_frequency=k > 1``): per-replica parameter copies
+  (stacked, sharded over ``data``) each step independently on their batch
+  shard via ``shard_map``; every k steps params+updater state are averaged
+  with ``pmean`` — the exact semantics of ``ParallelWrapper.java:145``
+  (``Nd4j.averageAndPropagate``) and
+  ``ParameterAveragingTrainingMaster.java:763-832``.
+"""
+
+from .mesh import create_mesh, data_parallel_mesh, mesh_devices
+from .wrapper import ParallelWrapper
+
+__all__ = ["ParallelWrapper", "create_mesh", "data_parallel_mesh",
+           "mesh_devices"]

@@ -1,0 +1,51 @@
+"""Device-mesh bootstrap.
+
+The analog of the reference's device discovery in ``ParallelWrapper``
+(worker count = ``Nd4j.getAffinityManager().getNumberOfDevices()``); here a
+``jax.sharding.Mesh`` over the local (or all) devices, with named axes that
+the rest of the framework shards over:
+
+  - ``data``  — batch dimension (dp)
+  - ``model`` — tensor-parallel dimension (tp), used by parallel/tensor.py
+  - ``seq``   — sequence/context-parallel dimension (sp), used by ring attention
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_devices(n: Optional[int] = None):
+    """First `n` available devices (default: all)."""
+    devs = jax.devices()
+    if n is not None:
+        if n > len(devs):
+            raise ValueError(
+                f"requested {n} devices but only {len(devs)} available "
+                f"({[d.platform for d in devs[:3]]}...); for CPU-mesh tests "
+                "set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "before JAX initializes")
+        devs = devs[:n]
+    return devs
+
+
+def create_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Mesh with named axes, e.g. ``create_mesh({"data": 4, "model": 2})``."""
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    total = int(np.prod(shape))
+    devs = devices if devices is not None else mesh_devices(total)
+    if len(devs) != total:
+        raise ValueError(f"mesh {axes} needs {total} devices, got {len(devs)}")
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(n: Optional[int] = None, devices=None) -> Mesh:
+    """1-D ``data`` mesh over n devices (default all local devices)."""
+    devs = devices if devices is not None else mesh_devices(n)
+    return Mesh(np.asarray(devs), ("data",))

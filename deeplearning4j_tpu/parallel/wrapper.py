@@ -1,0 +1,246 @@
+"""ParallelWrapper: data-parallel training over a device mesh.
+
+Parity: reference ``ParallelWrapper.java:37-204`` (single-node multi-device,
+parameter averaging every ``averagingFrequency`` iterations, updater-state
+averaging at ``:163-186``) and ``ParameterAveragingTrainingMaster.java:763-832``
+(the Spark multi-node variant of the same algorithm).
+
+See package docstring for the two modes (sync SPMD vs local-SGD).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import rng as _rng
+from ..optimize import updaters as _updaters
+from .mesh import data_parallel_mesh
+
+Pytree = Any
+
+
+def _tree_map(f, *trees):
+    # treat None as a leaf so optional masks ride through untouched
+    return jax.tree_util.tree_map(f, *trees, is_leaf=lambda x: x is None)
+
+
+class ParallelWrapper:
+    """Wrap an (initialized) network for data-parallel training.
+
+    Usage (mirrors the reference's builder)::
+
+        net = MultiLayerNetwork(conf).init()
+        pw = ParallelWrapper(net, mesh=None, averaging_frequency=1)
+        pw.fit(iterator, epochs=2)        # trains net in place
+
+    ``averaging_frequency=1`` → per-step gradient all-reduce (sync SPMD).
+    ``averaging_frequency=k>1`` → independent per-replica steps; params +
+    updater state + layer states averaged every k iterations.
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 averaging_frequency: int = 1):
+        if net.params is None:
+            net.init()
+        self.net = net
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        if "data" not in self.mesh.axis_names:
+            raise ValueError(f"mesh must have a 'data' axis, got {self.mesh.axis_names}")
+        self.averaging_frequency = int(averaging_frequency)
+        self.n_devices = self.mesh.shape["data"]
+        self._local: Optional[_LocalSgdState] = None
+        if self.averaging_frequency == 1:
+            # install the sharded step into the net's jit cache: net.fit then
+            # runs SPMD transparently
+            net._jit_cache["train_step"] = self._make_sync_step()
+        elif self.averaging_frequency < 1:
+            raise ValueError("averaging_frequency must be >= 1")
+
+    # ------------------------------------------------------------------
+    # sync mode: one SPMD step, batch sharded, params replicated
+    # ------------------------------------------------------------------
+
+    def _make_sync_step(self):
+        net = self.net
+        t = net.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = net._updater
+        repl = NamedSharding(self.mesh, P())
+        bsh = NamedSharding(self.mesh, P("data"))
+
+        def step(params, opt_state, states, x, y, mask, rng, iteration):
+            (loss, new_states), grads = jax.value_and_grad(
+                net._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, iteration)
+            params = _updaters.apply_updates(params, deltas)
+            return params, opt_state, new_states, loss
+
+        return jax.jit(
+            step,
+            donate_argnums=(0, 1),
+            in_shardings=(repl, repl, repl, bsh, bsh, bsh, repl, repl),
+            out_shardings=(repl, repl, repl, repl))
+
+    # ------------------------------------------------------------------
+    # local-SGD mode: stacked replicas via shard_map + periodic averaging
+    # ------------------------------------------------------------------
+
+    def _ensure_local(self) -> "_LocalSgdState":
+        if self._local is None:
+            self._local = _LocalSgdState(self)
+        return self._local
+
+    # ------------------------------------------------------------------
+    # fit API (delegates to net.fit in sync mode)
+    # ------------------------------------------------------------------
+
+    def fit(self, data, labels=None, *, epochs: int = 1, mask=None) -> None:
+        self._check_batch_divisibility_hint()
+        if self.averaging_frequency == 1:
+            self.net.fit(data, labels, epochs=epochs, mask=mask)
+            return
+        local = self._ensure_local()
+        net = self.net
+        for _ in range(epochs):
+            for l in net.listeners:
+                l.on_epoch_start(net, net.epoch_count)
+            for x, y, m in net._as_batches(data, labels, mask):
+                local.fit_batch(x, y, m)
+            for l in net.listeners:
+                l.on_epoch_end(net, net.epoch_count)
+            net.epoch_count += 1
+            if hasattr(data, "reset"):
+                data.reset()
+        local.sync_to_net()
+
+    def fit_batch(self, x, y, mask=None) -> float:
+        if self.averaging_frequency == 1:
+            return self.net.fit_batch(x, y, mask)
+        local = self._ensure_local()
+        loss = local.fit_batch(x, y, mask)
+        local.sync_to_net()
+        return loss
+
+    def _check_batch_divisibility_hint(self) -> None:
+        pass  # checked per batch where the shapes are known
+
+    def average_now(self) -> None:
+        """Force a parameter average (local-SGD mode)."""
+        if self._local is not None:
+            self._local.average()
+            self._local.sync_to_net()
+
+
+class _LocalSgdState:
+    """Per-replica parameter copies + the shard_map step (local-SGD mode)."""
+
+    def __init__(self, pw: ParallelWrapper):
+        self.pw = pw
+        self.net = pw.net
+        self.mesh = pw.mesh
+        self.n = pw.n_devices
+        self.k = pw.averaging_frequency
+        self._steps_since_avg = 0
+        net = self.net
+        stack = lambda a: jnp.broadcast_to(a[None], (self.n,) + a.shape)
+        dev_sh = NamedSharding(self.mesh, P("data"))
+        self.params = jax.device_put(_tree_map(stack, net.params), dev_sh)
+        self.opt_state = jax.device_put(_tree_map(stack, net.updater_state), dev_sh)
+        self.states = jax.device_put(_tree_map(stack, net._states_list()), dev_sh)
+        self._step = self._make_step()
+        self._avg = self._make_avg()
+
+    def _make_step(self):
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        net = self.net
+        t = net.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = net._updater
+        mesh = self.mesh
+
+        def per_replica(params, opt_state, states, x, y, mask, rng, iteration):
+            # leading replica axis has block size 1 on each device — drop it
+            params = _tree_map(lambda a: a[0], params)
+            opt_state = _tree_map(lambda a: a[0], opt_state)
+            states = _tree_map(lambda a: a[0], states)
+            # distinct dropout stream per replica
+            rng = (None if rng is None
+                   else jax.random.fold_in(rng, jax.lax.axis_index("data")))
+            (loss, new_states), grads = jax.value_and_grad(
+                net._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, iteration)
+            params = _updaters.apply_updates(params, deltas)
+            put_back = lambda a: a[None] if hasattr(a, "shape") else a
+            return (_tree_map(put_back, params), _tree_map(put_back, opt_state),
+                    _tree_map(put_back, new_states), loss[None])
+
+        Pd, Pr = P("data"), P()
+        step = shard_map(
+            per_replica, mesh=mesh,
+            in_specs=(Pd, Pd, Pd, Pd, Pd, Pd, Pr, Pr),
+            out_specs=(Pd, Pd, Pd, Pd))
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _make_avg(self):
+        def avg(tree):
+            return _tree_map(
+                lambda a: (jnp.broadcast_to(jnp.mean(a, axis=0, keepdims=True),
+                                            a.shape)
+                           if hasattr(a, "shape") else a), tree)
+        return jax.jit(avg, donate_argnums=(0,))
+
+    def fit_batch(self, x, y, mask=None) -> float:
+        net = self.net
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if x.shape[0] % self.n:
+            raise ValueError(
+                f"batch size {x.shape[0]} not divisible by the {self.n}-device "
+                "data axis")
+        if mask is not None:
+            mask = jnp.asarray(mask)
+        rng = _rng.fold_name(_rng.key(net.training.seed),
+                             f"update_{net._update_count}")
+        it = jnp.asarray(net._update_count, jnp.int32)
+        self.params, self.opt_state, self.states, loss = self._step(
+            self.params, self.opt_state, self.states, x, y, mask, rng, it)
+        net._update_count += 1
+        self._steps_since_avg += 1
+        if self._steps_since_avg >= self.k:
+            self.average()
+        score = jnp.mean(loss)  # stays on device; score() syncs lazily
+        net._score = score
+        net._fire_iteration(x.shape[0], score)
+        return score
+
+    def average(self) -> None:
+        """Parameter + updater-state + layer-state averaging
+        (parity: ``ParallelWrapper.java:145,:163-186``)."""
+        self.params = self._avg(self.params)
+        self.opt_state = self._avg(self.opt_state)
+        self.states = self._avg(self.states)
+        self._steps_since_avg = 0
+
+    def sync_to_net(self) -> None:
+        """Propagate replica-0 (= averaged) values back to the wrapped net."""
+        if self._steps_since_avg:
+            self.average()
+        take0 = lambda a: a[0] if hasattr(a, "shape") else a
+        net = self.net
+        net.params = _tree_map(take0, self.params)
+        net.updater_state = _tree_map(take0, self.opt_state)
+        states = _tree_map(take0, self.states)
+        net._persist_states(states)

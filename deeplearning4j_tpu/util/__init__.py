@@ -1,0 +1,9 @@
+"""Utilities: model serialization (checkpoint/resume), pytree helpers.
+
+Parity: reference ``deeplearning4j-nn/.../util/`` — chiefly
+``ModelSerializer.java:47-120`` (write) / ``:158-280`` (restore).
+"""
+
+from .serialization import ModelSerializer, load_model, save_model
+
+__all__ = ["ModelSerializer", "save_model", "load_model"]

@@ -7,13 +7,19 @@ distributed code path exercised in a single process.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin preloads jax at interpreter startup (sitecustomize), so
+# env vars like JAX_PLATFORMS are read too late — use the config API, which
+# works as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 # Gradient checks run in float64 (parity with the reference's double-precision
 # gradient checks, GradientCheckUtil.java); enable x64 support globally.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,142 @@
+"""Data pipeline tests (parity model: reference datasets iterator tests —
+DataSetIteratorTest.java, AsyncDataSetIteratorTest / MultipleEpochsIteratorTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator, AsyncDataSetIterator, DataSet,
+    ExistingDataSetIterator, IrisDataSetIterator, ListDataSetIterator,
+    MnistDataSetIterator, MultipleEpochsIterator, SamplingDataSetIterator)
+
+
+class TestDataSet:
+    def test_split_test_and_train(self):
+        ds = DataSet(np.arange(20).reshape(10, 2), np.eye(10))
+        tr, te = ds.split_test_and_train(0.7)
+        assert tr.num_examples() == 7 and te.num_examples() == 3
+        tr2, te2 = ds.split_test_and_train(4)
+        assert tr2.num_examples() == 4 and te2.num_examples() == 6
+
+    def test_batch_by_and_merge_roundtrip(self):
+        ds = DataSet(np.arange(30).reshape(10, 3), np.eye(10))
+        batches = ds.batch_by(4)
+        assert [b.num_examples() for b in batches] == [4, 4, 2]
+        merged = DataSet.merge(batches)
+        assert np.array_equal(merged.features, ds.features)
+
+    def test_shuffle_is_consistent_across_arrays(self):
+        feats = np.arange(10)[:, None].astype(float)
+        labels = np.arange(10)[:, None].astype(float)
+        ds = DataSet(feats, labels)
+        ds.shuffle(seed=0)
+        assert np.array_equal(ds.features, ds.labels)
+        assert not np.array_equal(ds.features.ravel(), np.arange(10))
+
+    def test_normalization(self):
+        ds = DataSet(np.array([[0.0], [5.0], [10.0]]), np.zeros((3, 1)))
+        ds.scale_min_max()
+        assert ds.features.min() == 0.0 and ds.features.max() == 1.0
+
+
+class TestIterators:
+    def test_array_iterator_batching(self):
+        it = ArrayDataSetIterator(np.zeros((25, 4)), np.zeros((25, 2)), 10)
+        sizes = [b.num_examples() for b in it]
+        assert sizes == [10, 10, 5]
+        it.reset()
+        assert sum(1 for _ in it) == 3
+
+    def test_list_iterator(self):
+        dss = [DataSet(np.zeros((5, 2)), np.zeros((5, 2))) for _ in range(3)]
+        it = ListDataSetIterator(dss)
+        assert sum(1 for _ in it) == 3
+        it.reset()
+        assert it.has_next()
+
+    def test_existing_iterator_reset(self):
+        dss = [DataSet(np.zeros((2, 2)), np.zeros((2, 2))) for _ in range(4)]
+        it = ExistingDataSetIterator(dss)
+        assert sum(1 for _ in it) == 4
+        it.reset()
+        assert sum(1 for _ in it) == 4
+
+    def test_multiple_epochs(self):
+        base = ArrayDataSetIterator(np.zeros((8, 2)), np.zeros((8, 2)), 4)
+        it = MultipleEpochsIterator(3, base)
+        assert sum(1 for _ in it) == 6  # 2 batches × 3 epochs
+
+    def test_sampling_iterator(self):
+        ds = DataSet(np.random.default_rng(0).normal(size=(50, 3)), np.zeros((50, 2)))
+        it = SamplingDataSetIterator(ds, batch_size=8, total_batches=5, seed=1)
+        batches = list(it)
+        assert len(batches) == 5
+        assert all(b.num_examples() == 8 for b in batches)
+        it.reset()
+        again = list(it)
+        assert np.array_equal(again[0].features, batches[0].features)  # deterministic
+
+
+class TestAsyncIterator:
+    def test_same_content_as_sync(self):
+        feats = np.arange(40).reshape(20, 2).astype(float)
+        base = ArrayDataSetIterator(feats, np.zeros((20, 2)), 6)
+        sync = [b.features.copy() for b in base]
+        base.reset()
+        async_it = AsyncDataSetIterator(base, queue_size=3)
+        got = [np.asarray(b.features) for b in async_it]
+        assert len(got) == len(sync)
+        for a, b in zip(got, sync):
+            assert np.array_equal(a, b)
+
+    def test_reset_restarts(self):
+        base = ArrayDataSetIterator(np.zeros((12, 2)), np.zeros((12, 2)), 4)
+        it = AsyncDataSetIterator(base)
+        assert sum(1 for _ in it) == 3
+        it.reset()
+        assert sum(1 for _ in it) == 3
+
+    def test_error_propagates(self):
+        class Boom(ArrayDataSetIterator):
+            def next(self):
+                raise RuntimeError("boom")
+        it = AsyncDataSetIterator(Boom(np.zeros((4, 1)), np.zeros((4, 1)), 2))
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_device_put(self):
+        base = ArrayDataSetIterator(np.ones((8, 3)), np.zeros((8, 2)), 4)
+        it = AsyncDataSetIterator(base, device_put=True)
+        import jax
+        b = it.next()
+        assert isinstance(b.features, jax.Array)
+
+
+class TestFetchers:
+    def test_mnist_shapes_and_range(self):
+        it = MnistDataSetIterator(16, 64, seed=7)
+        ds = it.next()
+        assert ds.features.shape == (16, 784)
+        assert ds.labels.shape == (16, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        assert np.allclose(ds.labels.sum(axis=1), 1.0)
+
+    def test_mnist_deterministic_and_split(self):
+        a = MnistDataSetIterator(32, 64, seed=7).next()
+        b = MnistDataSetIterator(32, 64, seed=7).next()
+        assert np.array_equal(a.features, b.features)
+        # train vs test draws differ
+        tr = MnistDataSetIterator(32, 64, train=True, seed=7).next()
+        te = MnistDataSetIterator(32, 64, train=False, seed=7).next()
+        assert not np.array_equal(tr.features, te.features)
+
+    def test_mnist_binarize(self):
+        ds = MnistDataSetIterator(16, 32, binarize=True).next()
+        assert set(np.unique(ds.features)) <= {0.0, 1.0}
+
+    def test_iris(self):
+        it = IrisDataSetIterator(150, 150)
+        ds = it.next()
+        assert ds.features.shape == (150, 4)
+        assert ds.labels.shape == (150, 3)
+        assert np.allclose(ds.labels.sum(axis=0), [50, 50, 50])

@@ -1,0 +1,157 @@
+"""Evaluation tests (parity model: reference eval/EvaluationToolsTests,
+EvalTest.java — exact-count assertions on small crafted batches)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (
+    ConfusionMatrix, Evaluation, RegressionEvaluation, ROC, ROCMultiClass)
+
+
+class TestEvaluation:
+    def test_perfect_predictions(self):
+        ev = Evaluation()
+        y = np.eye(4)[[0, 1, 2, 3, 0, 1]]
+        ev.eval(y, y)
+        assert ev.accuracy() == 1.0
+        assert ev.precision() == 1.0
+        assert ev.recall() == 1.0
+        assert ev.f1() == 1.0
+
+    def test_counts_and_per_class(self):
+        ev = Evaluation()
+        actual = [0, 0, 1, 1, 1, 2]
+        predicted = [0, 1, 1, 1, 2, 2]
+        ev.eval(np.eye(3)[actual], np.eye(3)[predicted])
+        assert ev.true_positives(1) == 2
+        assert ev.false_positives(1) == 1
+        assert ev.false_negatives(1) == 1
+        assert ev.accuracy() == pytest.approx(4 / 6)
+        assert ev.precision(1) == pytest.approx(2 / 3)
+        assert ev.recall(1) == pytest.approx(2 / 3)
+
+    def test_streaming_equals_single_batch(self):
+        rng = np.random.default_rng(0)
+        y = np.eye(5)[rng.integers(0, 5, 200)]
+        p = rng.random((200, 5))
+        ev1 = Evaluation(); ev1.eval(y, p)
+        ev2 = Evaluation()
+        for i in range(0, 200, 32):
+            ev2.eval(y[i:i + 32], p[i:i + 32])
+        assert ev1.accuracy() == ev2.accuracy()
+        assert np.array_equal(ev1.confusion.matrix, ev2.confusion.matrix)
+
+    def test_merge_matches_combined(self):
+        rng = np.random.default_rng(1)
+        y = np.eye(3)[rng.integers(0, 3, 100)]
+        p = rng.random((100, 3))
+        full = Evaluation(); full.eval(y, p)
+        a, b = Evaluation(), Evaluation()
+        a.eval(y[:50], p[:50]); b.eval(y[50:], p[50:])
+        a.merge(b)
+        assert np.array_equal(a.confusion.matrix, full.confusion.matrix)
+
+    def test_time_series_with_mask(self):
+        # [b=2, t=3, c=2]; second row has last 2 steps masked
+        y = np.zeros((2, 3, 2)); y[..., 0] = 1
+        p = np.zeros((2, 3, 2)); p[..., 0] = 1
+        p[1, 1] = [0, 1]  # wrong but masked
+        p[1, 2] = [0, 1]  # wrong but masked
+        mask = np.array([[1, 1, 1], [1, 0, 0]], dtype=np.float32)
+        ev = Evaluation()
+        ev.eval(y, p, mask=mask)
+        assert ev.num_examples() == 4
+        assert ev.accuracy() == 1.0
+
+    def test_int_labels(self):
+        ev = Evaluation(num_classes=3)
+        ev.eval(np.array([0, 1, 2]), np.array([0, 1, 1]))
+        assert ev.accuracy() == pytest.approx(2 / 3)
+
+    def test_stats_renders(self):
+        ev = Evaluation(labels=["cat", "dog"])
+        ev.eval(np.eye(2)[[0, 1]], np.eye(2)[[0, 1]])
+        s = ev.stats()
+        assert "Accuracy" in s and "cat" in s
+
+
+class TestConfusionMatrix:
+    def test_add_and_totals(self):
+        cm = ConfusionMatrix(range(3))
+        cm.add(0, 1); cm.add(0, 1); cm.add(1, 1)
+        assert cm.count(0, 1) == 2
+        assert cm.actual_total(0) == 2
+        assert cm.predicted_total(1) == 3
+        assert cm.total() == 3
+
+
+class TestRegressionEvaluation:
+    def test_exact_values(self):
+        re = RegressionEvaluation()
+        labels = np.array([[1.0], [2.0], [3.0]])
+        preds = np.array([[1.5], [2.0], [2.5]])
+        re.eval(labels, preds)
+        assert re.mean_squared_error(0) == pytest.approx((0.25 + 0 + 0.25) / 3)
+        assert re.mean_absolute_error(0) == pytest.approx(1.0 / 3)
+        assert re.root_mean_squared_error(0) == pytest.approx(
+            np.sqrt((0.25 + 0 + 0.25) / 3))
+
+    def test_r2_perfect_linear(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(100, 2))
+        re = RegressionEvaluation()
+        re.eval(x, 2 * x + 1)  # perfectly correlated
+        assert re.average_correlation_r2() == pytest.approx(1.0)
+
+    def test_streaming_merge(self):
+        rng = np.random.default_rng(3)
+        y = rng.normal(size=(80, 3)); p = y + rng.normal(0, 0.1, (80, 3))
+        full = RegressionEvaluation(); full.eval(y, p)
+        a, b = RegressionEvaluation(), RegressionEvaluation()
+        a.eval(y[:40], p[:40]); b.eval(y[40:], p[40:])
+        a.merge(b)
+        assert a.average_mean_squared_error() == pytest.approx(
+            full.average_mean_squared_error())
+        assert a.average_correlation_r2() == pytest.approx(
+            full.average_correlation_r2())
+
+
+class TestROC:
+    def test_perfect_separation_auc_1(self):
+        roc = ROC(100)
+        labels = np.array([0] * 50 + [1] * 50)
+        preds = np.array([0.1] * 50 + [0.9] * 50)
+        roc.eval(labels, preds)
+        assert roc.calculate_auc() == pytest.approx(1.0, abs=0.02)
+
+    def test_random_predictions_auc_half(self):
+        rng = np.random.default_rng(4)
+        roc = ROC(200)
+        roc.eval(rng.integers(0, 2, 5000), rng.random(5000))
+        assert roc.calculate_auc() == pytest.approx(0.5, abs=0.05)
+
+    def test_one_hot_two_column_form(self):
+        roc = ROC(50)
+        labels = np.eye(2)[[0, 1, 1, 0]]
+        preds = np.array([[0.8, 0.2], [0.1, 0.9], [0.4, 0.6], [0.7, 0.3]])
+        roc.eval(labels, preds)
+        assert roc.calculate_auc() == pytest.approx(1.0, abs=0.05)
+
+    def test_merge(self):
+        rng = np.random.default_rng(5)
+        lab = rng.integers(0, 2, 400); pred = rng.random(400)
+        full = ROC(100); full.eval(lab, pred)
+        a, b = ROC(100), ROC(100)
+        a.eval(lab[:200], pred[:200]); b.eval(lab[200:], pred[200:])
+        a.merge(b)
+        assert a.calculate_auc() == pytest.approx(full.calculate_auc())
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(6)
+        y_idx = rng.integers(0, 3, 300)
+        y = np.eye(3)[y_idx]
+        p = np.clip(y * 0.7 + rng.random((300, 3)) * 0.3, 0, 1)
+        mroc = ROCMultiClass(100)
+        mroc.eval(y, p)
+        assert mroc.calculate_average_auc() > 0.8
+        assert 0 <= mroc.calculate_auc(0) <= 1.0
