@@ -1,60 +1,32 @@
-"""Benchmark: LeNet-5 MNIST training throughput on the real TPU chip.
+"""Benchmark: ResNet-50 (headline, BASELINE.md config #2) + LeNet (config #1)
+training throughput on the real TPU chip.
 
-BASELINE.md config #1 (LeNet-5 MNIST via the fit() API). Prints ONE JSON line:
+Prints ONE JSON line:
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}``.
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
-reported against the north-star instrumentation target: the ratio of measured
-MFU to the 40% MFU goal (BASELINE.json). Extra keys carry the raw numbers.
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` reports
+measured MFU / the 40% MFU north-star target (BASELINE.json). Extra keys
+carry the raw numbers for both configs.
+
+Both configs train via the scan-fused path (K steps per dispatch) — the
+framework's idiomatic TPU inner loop, which also amortizes the dev-tunnel's
+~100ms per-dispatch RPC latency out of the measurement.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
+import traceback
 
 import numpy as np
 
 
-def _flops_per_example(conf, input_shape) -> float:
-    """Analytic forward FLOPs for conv/dense layers (2*MACs); backward ≈ 2×
-    forward, so a train step ≈ 3× forward FLOPs (standard MFU accounting)."""
-    from deeplearning4j_tpu.nn.conf.layers import (
-        ConvolutionLayer, DenseLayer, BaseOutputLayer)
-    from deeplearning4j_tpu.nn.conf.inputs import InputType
-
-    it = conf.input_type
-    flops = 0.0
-    h, w, c = (it.height, it.width, it.channels or 1)
-    cur = InputType.convolutional(h, w, c)
-    for layer in conf.layers:
-        if isinstance(layer, ConvolutionLayer):
-            out_t = layer.output_type(cur)
-            kh, kw = layer.kernel_size
-            macs = (out_t.height * out_t.width * layer.n_out
-                    * kh * kw * (layer.n_in or c))
-            flops += 2.0 * macs
-            cur = out_t
-        elif isinstance(layer, (DenseLayer, BaseOutputLayer)):
-            flops += 2.0 * float(layer.n_in or 0) * float(layer.n_out or 0)
-            if hasattr(layer, "output_type"):
-                cur = layer.output_type(cur) if cur is not None else cur
-        else:
-            out_f = getattr(layer, "output_type", None)
-            if out_f is not None:
-                try:
-                    cur = out_f(cur)
-                except Exception:
-                    pass
-    return flops
-
-
 def _peak_flops_per_sec() -> float:
-    """Per-chip peak. TPU v5e: 197 TFLOP/s bf16 / 99 TF f32-ish via MXU.
-    We report MFU against the bf16 peak (conservative)."""
+    """Per-chip peak (bf16). TPU v5e ≈ 197 TFLOP/s."""
     import jax
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
     if "v5 lite" in kind or "v5e" in kind:
         return 197e12
     if "v4" in kind:
@@ -63,57 +35,187 @@ def _peak_flops_per_sec() -> float:
         return 459e12
     if "v6" in kind:
         return 918e12
-    return 197e12  # default to v5e
+    return 197e12
+
+
+def _conv_flops_nhwc(h, w, c_in, c_out, kh, kw, stride):
+    oh, ow = -(-h // stride), -(-w // stride)
+    return 2.0 * oh * ow * c_out * kh * kw * c_in, oh, ow
+
+
+def _resnet50_train_flops_per_example(image=224, n_classes=1000) -> float:
+    """Analytic fwd FLOPs for standard bottleneck ResNet-50 (≈4.1 GFLOP fwd
+    at 224², matching the published figure); train ≈ 3× fwd."""
+    total = 0.0
+    f, h = 0.0, image
+    # stem 7x7/2 ch 3->64
+    f, oh, _ = _conv_flops_nhwc(h, h, 3, 64, 7, 7, 2)
+    total += f
+    h = oh
+    h = -(-h // 2)  # maxpool /2
+    c_in = 64
+    for stage, (planes, blocks) in enumerate(
+            [(64, 3), (128, 4), (256, 6), (512, 3)]):
+        for i in range(blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            oh = -(-h // stride)
+            # 1x1 reduce (at input res), 3x3 (stride), 1x1 expand
+            f1, _, _ = _conv_flops_nhwc(h, h, c_in, planes, 1, 1, 1)
+            f2, _, _ = _conv_flops_nhwc(h, h, planes, planes, 3, 3, stride)
+            f3, _, _ = _conv_flops_nhwc(oh, oh, planes, planes * 4, 1, 1, 1)
+            total += f1 + f2 + f3
+            if i == 0:
+                fp, _, _ = _conv_flops_nhwc(h, h, c_in, planes * 4, 1, 1, stride)
+                total += fp
+            c_in = planes * 4
+            h = oh
+    total += 2.0 * c_in * n_classes  # fc head
+    return 3.0 * total
+
+
+def _lenet_train_flops_per_example() -> float:
+    fwd = (2.0 * 24 * 24 * 20 * 5 * 5 * 1      # conv1
+           + 2.0 * 8 * 8 * 50 * 5 * 5 * 20     # conv2
+           + 2.0 * 800 * 500                   # dense
+           + 2.0 * 500 * 10)                   # out
+    return 3.0 * fwd
+
+
+def _stage_batches(k, batch, shape, n_classes, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(k, batch) + shape).astype(np.float32)
+    ys = np.eye(n_classes, dtype=np.float32)[
+        rng.integers(0, n_classes, (k, batch))]
+    return xs, ys
+
+
+def _time_scan(net, xs, ys, rounds) -> float:
+    # NB: np.asarray (device→host transfer) is the completion barrier;
+    # block_until_ready returns early through the axon dev tunnel.
+    np.asarray(net.fit_scan(xs, ys))  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        losses = net.fit_scan(xs, ys)
+    np.asarray(losses)
+    return time.perf_counter() - t0
+
+
+def bench_lenet() -> dict:
+    import jax
+    from deeplearning4j_tpu.models import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch, k, rounds = 512, 32, 4
+    net = MultiLayerNetwork(lenet()).init()
+    xs, ys = _stage_batches(k, batch, (784,), 10, seed=7)
+    xs, ys = jax.device_put(xs), jax.device_put(ys)
+    dt = _time_scan(net, xs, ys, rounds)
+    steps = rounds * k
+    eps = steps * batch / dt
+    mfu = eps * _lenet_train_flops_per_example() / _peak_flops_per_sec()
+    return {"examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
+            "step_ms": round(1000 * dt / steps, 3), "batch": batch}
+
+
+def bench_resnet50() -> dict:
+    """ResNet-50 training MFU. The K-step inner loop closes over ONE staged
+    device batch (lax.scan over step indices), so arbitrarily long on-chip
+    runs cost one batch of HBM — the measurement isolates train-step compute
+    the way a production input pipeline (prefetching while computing) would."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import resnet50
+    from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+    from deeplearning4j_tpu.optimize import updaters as _updaters
+    from deeplearning4j_tpu import rng as _rng
+
+    image = int(os.environ.get("BENCH_RESNET_IMAGE", "224"))
+    batch = int(os.environ.get("BENCH_RESNET_BATCH", "128"))
+    k = int(os.environ.get("BENCH_RESNET_SCAN", "32"))
+    rounds = 2
+    conf = resnet50(height=image, width=image,
+                    dtype=os.environ.get("BENCH_RESNET_DTYPE", "mixed_bf16"))
+    net = ComputationGraph(conf).init()
+    xs, ys = _stage_batches(1, batch, (image, image, 3), 1000, seed=11)
+    x = jax.device_put(xs[0])
+    y = jax.device_put(ys[0])
+
+    t = net.training
+    updater = net._updater
+    base_key = _rng.key(t.seed)
+
+    def k_steps(params, opt_state, states, x, y):
+        def one(carry, i):
+            params, opt_state, states = carry
+            rng = jax.random.fold_in(base_key, i)
+            (loss, new_states), grads = jax.value_and_grad(
+                net._loss_fn, has_aux=True)(
+                    params, states, [x], [y], None, rng)
+            deltas, opt_state = updater.update(grads, opt_state, i)
+            params = _updaters.apply_updates(params, deltas)
+            kept = {name: {kk: new_states[name].get(kk, v)
+                           for kk, v in st.items()}
+                    for name, st in states.items()}
+            return (params, opt_state, kept), loss
+        (params, opt_state, states), losses = jax.lax.scan(
+            one, (params, opt_state, states), jnp.arange(k))
+        return params, opt_state, states, losses
+
+    step = jax.jit(k_steps, donate_argnums=(0, 1))
+    params, opt_state, states = net.params, net.updater_state, net._states_map()
+    params, opt_state, states, losses = step(params, opt_state, states, x, y)
+    np.asarray(losses)  # warmup/compile; host transfer = completion barrier
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        params, opt_state, states, losses = step(params, opt_state, states, x, y)
+    np.asarray(losses)
+    dt = time.perf_counter() - t0
+
+    steps = rounds * k
+    eps = steps * batch / dt
+    mfu = (eps * _resnet50_train_flops_per_example(image)
+           / _peak_flops_per_sec())
+    return {"examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
+            "step_ms": round(1000 * dt / steps, 3), "batch": batch,
+            "image": image}
 
 
 def main() -> None:
     import jax
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    from deeplearning4j_tpu.datasets import MnistDataSetIterator
-    from __graft_entry__ import _lenet_conf
+    device = str(jax.devices()[0].device_kind)
+    out = {"device": device}
+    lenet_res = None
+    try:
+        lenet_res = bench_lenet()
+        out["lenet"] = lenet_res
+    except Exception:
+        out["lenet_error"] = traceback.format_exc(limit=2)
+    resnet_res = None
+    if os.environ.get("BENCH_SKIP_RESNET") != "1":
+        try:
+            resnet_res = bench_resnet50()
+            out["resnet50"] = resnet_res
+        except Exception:
+            out["resnet50_error"] = traceback.format_exc(limit=2)
 
-    batch = 512
-    conf = _lenet_conf()
-    net = MultiLayerNetwork(conf).init()
-
-    # stage K batches on device, train via the scan-fused path (ONE XLA
-    # program per K steps — no per-step host dispatch; this is the framework's
-    # idiomatic TPU inner loop, and it sidesteps the dev-tunnel RPC latency
-    # that would otherwise dominate a per-step measurement)
-    k = 8
-    it = MnistDataSetIterator(batch, batch * k, seed=7, shuffle=False)
-    xs = np.stack([np.asarray(d.features, np.float32) for d in it])
-    ys = np.stack([np.asarray(d.labels, np.float32) for d in it])
-    xs, ys = jax.device_put(xs), jax.device_put(ys)
-
-    # warmup/compile
-    jax.block_until_ready(net.fit_scan(xs, ys))
-
-    rounds = 6
-    t0 = time.perf_counter()
-    for _ in range(rounds):
-        losses = net.fit_scan(xs, ys)
-    jax.block_until_ready(losses)
-    dt = time.perf_counter() - t0
-
-    steps = rounds * k
-    examples_per_sec = steps * batch / dt
-    train_flops_per_example = 3.0 * _flops_per_example(conf, (28, 28, 1))
-    achieved = examples_per_sec * train_flops_per_example
-    mfu = achieved / _peak_flops_per_sec()
-
-    print(json.dumps({
-        "metric": "lenet_mnist_train_throughput",
-        "value": round(examples_per_sec, 1),
-        "unit": "examples/sec",
-        "vs_baseline": round(mfu / 0.40, 4),
-        "mfu": round(mfu, 4),
-        "step_ms": round(1000 * dt / steps, 3),
-        "batch": batch,
-        "flops_per_example_train": train_flops_per_example,
-        "device": str(jax.devices()[0].device_kind),
-        "final_score": float(losses[-1]),
-    }))
+    if resnet_res is not None:
+        out.update({
+            "metric": "resnet50_train_throughput_per_chip",
+            "value": resnet_res["examples_per_sec"],
+            "unit": "examples/sec",
+            "vs_baseline": round(resnet_res["mfu"] / 0.40, 4),
+        })
+    elif lenet_res is not None:
+        out.update({
+            "metric": "lenet_mnist_train_throughput",
+            "value": lenet_res["examples_per_sec"],
+            "unit": "examples/sec",
+            "vs_baseline": round(lenet_res["mfu"] / 0.40, 4),
+        })
+    else:
+        out.update({"metric": "bench_failed", "value": 0.0,
+                    "unit": "examples/sec", "vs_baseline": 0.0})
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

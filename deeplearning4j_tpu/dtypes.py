@@ -62,7 +62,7 @@ def policy_from_name(name: str) -> DtypePolicy:
     name = name.lower()
     if name in ("float32", "f32", "single"):
         return FLOAT32
-    if name in ("bfloat16", "bf16", "mixed", "mixed_bfloat16"):
+    if name in ("bfloat16", "bf16", "mixed", "mixed_bf16", "mixed_bfloat16"):
         return MIXED_BF16
     if name in ("float64", "f64", "double"):
         return FLOAT64

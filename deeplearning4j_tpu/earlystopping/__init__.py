@@ -1,0 +1,35 @@
+"""Early stopping: epoch-loop driver with score calculators, termination
+conditions, and model savers.
+
+Parity: reference ``deeplearning4j-nn/.../earlystopping/`` —
+``EarlyStoppingConfiguration``, ``trainer/BaseEarlyStoppingTrainer`` /
+``EarlyStoppingTrainer`` / ``EarlyStoppingGraphTrainer``,
+``scorecalc/DataSetLossCalculator``, ``termination/`` (MaxEpochs, MaxTime,
+MaxScore, ScoreImprovement, BestScoreEpoch, InvalidScore), ``saver/``
+(InMemory, LocalFile).
+"""
+
+from .config import EarlyStoppingConfiguration, EarlyStoppingResult
+from .savers import InMemoryModelSaver, LocalFileModelSaver
+from .scorecalc import DataSetLossCalculator, EvaluationScoreCalculator
+from .termination import (
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from .trainer import EarlyStoppingGraphTrainer, EarlyStoppingTrainer
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult",
+    "EarlyStoppingTrainer", "EarlyStoppingGraphTrainer",
+    "DataSetLossCalculator", "EvaluationScoreCalculator",
+    "MaxEpochsTerminationCondition", "MaxTimeTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+    "InMemoryModelSaver", "LocalFileModelSaver",
+]

@@ -1,0 +1,106 @@
+"""Model savers (parity: reference ``earlystopping/saver/`` — InMemory,
+LocalFileModelSaver persisting best/latest models)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class ModelSaver:
+    def save_best_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(ModelSaver):
+    """Keeps deep param copies in memory (parity: ``InMemoryModelSaver``)."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    @staticmethod
+    def _snapshot(net):
+        import jax
+        import copy
+        return {
+            "conf_json": net.conf.to_json(),
+            "params": net.clone_params(),
+            "state": jax.tree_util.tree_map(lambda a: a, net.state),
+            "model_class": type(net).__name__,
+        }
+
+    @staticmethod
+    def _restore(snap):
+        if snap is None:
+            return None
+        if snap["model_class"] == "ComputationGraph":
+            from ..nn.graph_runtime import ComputationGraph
+            from ..nn.conf.graph import ComputationGraphConfiguration
+            net = ComputationGraph(
+                ComputationGraphConfiguration.from_json(snap["conf_json"])).init()
+        else:
+            from ..nn.multilayer import MultiLayerNetwork
+            from ..nn.conf.multi_layer import MultiLayerConfiguration
+            net = MultiLayerNetwork(
+                MultiLayerConfiguration.from_json(snap["conf_json"])).init()
+        net.params = snap["params"]
+        net.state = snap["state"]
+        return net
+
+    def save_best_model(self, net, score: float) -> None:
+        self._best = self._snapshot(net)
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._latest = self._snapshot(net)
+
+    def get_best_model(self):
+        return self._restore(self._best)
+
+    def get_latest_model(self):
+        return self._restore(self._latest)
+
+
+class LocalFileModelSaver(ModelSaver):
+    """Writes checkpoint zips to a directory (parity: ``LocalFileModelSaver``)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def best_path(self) -> str:
+        return os.path.join(self.directory, "bestModel.zip")
+
+    @property
+    def latest_path(self) -> str:
+        return os.path.join(self.directory, "latestModel.zip")
+
+    def save_best_model(self, net, score: float) -> None:
+        from ..util import save_model
+        save_model(net, self.best_path)
+
+    def save_latest_model(self, net, score: float) -> None:
+        from ..util import save_model
+        save_model(net, self.latest_path)
+
+    def _load(self, path: str):
+        if not os.path.exists(path):
+            return None
+        from ..util import load_model
+        return load_model(path)
+
+    def get_best_model(self):
+        return self._load(self.best_path)
+
+    def get_latest_model(self):
+        return self._load(self.latest_path)

@@ -1,0 +1,120 @@
+"""Early-stopping trainers (parity: reference
+``earlystopping/trainer/BaseEarlyStoppingTrainer.java`` — the epoch loop:
+fit one epoch → every N epochs compute held-out score → save best → poll
+termination conditions; iteration conditions polled per minibatch).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .config import EarlyStoppingConfiguration, EarlyStoppingResult
+
+
+class BaseEarlyStoppingTrainer:
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_data):
+        self.config = config
+        self.net = net
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        net = self.net
+        if net.params is None:
+            net.init()
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+
+        best_score: Optional[float] = None
+        best_epoch = -1
+        score_vs_epoch = {}
+        epoch = 0
+        reason, details = "epoch_condition", ""
+
+        while True:
+            stop_iteration = None
+            for x, y, mask in self._batches():
+                loss = float(self._fit_batch(x, y, mask))
+                for c in cfg.iteration_termination_conditions:
+                    if c.terminate(loss):
+                        stop_iteration = c
+                        break
+                if stop_iteration is not None:
+                    break
+            if hasattr(self.train_data, "reset"):
+                self.train_data.reset()
+
+            if stop_iteration is not None:
+                reason = "iteration_condition"
+                details = repr(stop_iteration)
+                break
+
+            last_score = None
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                last_score = float(cfg.score_calculator.calculate_score(net))
+                score_vs_epoch[epoch] = last_score
+                if best_score is None or last_score < best_score:
+                    best_score, best_epoch = last_score, epoch
+                    cfg.model_saver.save_best_model(net, last_score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(net, last_score)
+            # epoch conditions are polled EVERY epoch (parity with the
+            # reference loop), using the most recent score when this epoch
+            # had no evaluation
+            poll_score = (last_score if last_score is not None
+                          else (score_vs_epoch[max(score_vs_epoch)]
+                                if score_vs_epoch else float("inf")))
+            stop_epoch = None
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, poll_score):
+                    stop_epoch = c
+                    break
+            if stop_epoch is not None:
+                reason = "epoch_condition"
+                details = repr(stop_epoch)
+                break
+            epoch += 1
+
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            total_epochs=epoch + 1,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score if best_score is not None else float("nan"),
+            score_vs_epoch=score_vs_epoch,
+            best_model=cfg.model_saver.get_best_model(),
+        )
+
+    def _fit_batch(self, x, y, mask):
+        raise NotImplementedError
+
+    def _batches(self):
+        """Yield (features, labels, mask) triples from train_data."""
+        data = self.train_data
+        if hasattr(data, "features"):
+            yield (data.features, data.labels,
+                   getattr(data, "features_mask", None))
+            return
+        for item in data:
+            if hasattr(item, "features"):
+                yield (item.features, item.labels,
+                       getattr(item, "features_mask", None))
+            else:
+                x, y = item[0], item[1]
+                yield (x, y, item[2] if len(item) > 2 else None)
+
+
+class EarlyStoppingTrainer(BaseEarlyStoppingTrainer):
+    """For MultiLayerNetwork (parity: ``EarlyStoppingTrainer.java``)."""
+
+    def _fit_batch(self, x, y, mask):
+        return self.net.fit_batch(x, y, mask)
+
+
+class EarlyStoppingGraphTrainer(BaseEarlyStoppingTrainer):
+    """For ComputationGraph (parity: ``EarlyStoppingGraphTrainer.java``)."""
+
+    def _fit_batch(self, x, y, mask):
+        return self.net.fit_batch(x, y, None if mask is None else [mask])

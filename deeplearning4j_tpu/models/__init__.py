@@ -1,0 +1,15 @@
+"""Model zoo: canonical configs for the benchmark/parity suite.
+
+The reference era has no in-tree model zoo (its examples repo served that
+role); these builders produce the BASELINE.md configs:
+
+  #1 LeNet-5 (MNIST, sequential)            — lenet()
+  #2 ResNet-50 (ImageNet-class, DAG)        — resnet50() / resnet()
+  #3 GravesLSTM char-RNN                    — char_rnn_lstm()
+"""
+
+from .lenet import lenet
+from .resnet import resnet, resnet50
+from .char_rnn import char_rnn_lstm
+
+__all__ = ["lenet", "resnet", "resnet50", "char_rnn_lstm"]

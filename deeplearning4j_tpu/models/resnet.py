@@ -1,0 +1,90 @@
+"""ResNet (BASELINE.md config #2): the DAG/MFU benchmark model.
+
+Standard bottleneck ResNet (He et al. 2015) expressed in the framework's own
+GraphBuilder DSL — conv(+BN) vertices, ElementWiseVertex residual sums,
+projection shortcuts on stride-2 stage boundaries, global average pool head.
+
+TPU-native notes: NHWC layout throughout; BN fuses into the conv epilogue
+under XLA; the whole DAG becomes one jitted program, so depth costs no
+dispatch overhead.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..nn.conf.builders import NeuralNetConfiguration
+from ..nn.conf.graph import ElementWiseVertex, GraphBuilder
+from ..nn.conf.inputs import InputType
+from ..nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, GlobalPoolingLayer,
+    OutputLayer, SubsamplingLayer)
+
+
+def _conv_bn(gb: GraphBuilder, name: str, inp: str, n_out: int,
+             kernel, stride=(1, 1), activation: str = "relu") -> str:
+    gb.add_layer(f"{name}_conv", ConvolutionLayer(
+        n_out=n_out, kernel_size=tuple(kernel), stride=tuple(stride),
+        border_mode="same", activation="identity", bias_init=0.0), inp)
+    gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+    if activation == "identity":
+        return f"{name}_bn"
+    gb.add_layer(f"{name}_act", ActivationLayer(activation=activation),
+                 f"{name}_bn")
+    return f"{name}_act"
+
+
+def _bottleneck(gb: GraphBuilder, name: str, inp: str, planes: int,
+                stride: int, project: bool) -> str:
+    """1x1 reduce → 3x3 → 1x1 expand (4×), + shortcut, relu."""
+    c1 = _conv_bn(gb, f"{name}_a", inp, planes, (1, 1), (1, 1), "relu")
+    c2 = _conv_bn(gb, f"{name}_b", c1, planes, (3, 3), (stride, stride), "relu")
+    c3 = _conv_bn(gb, f"{name}_c", c2, planes * 4, (1, 1), (1, 1), "identity")
+    if project:
+        sc = _conv_bn(gb, f"{name}_proj", inp, planes * 4, (1, 1),
+                      (stride, stride), "identity")
+    else:
+        sc = inp
+    gb.add_vertex(f"{name}_sum", ElementWiseVertex(op="add"), c3, sc)
+    gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                 f"{name}_sum")
+    return f"{name}_relu"
+
+
+def resnet(blocks: Sequence[int] = (3, 4, 6, 3), *,
+           height: int = 224, width: int = 224, channels: int = 3,
+           n_classes: int = 1000, width_base: int = 64,
+           updater: str = "sgd", learning_rate: float = 0.1,
+           momentum: float = 0.9, seed: int = 42, dtype: str = "mixed_bf16"):
+    """Bottleneck ResNet as a ComputationGraphConfiguration.
+
+    ``blocks=(3,4,6,3)`` → ResNet-50. Smaller test nets: ``blocks=(1,1)``,
+    reduced ``width_base``/image size.
+    """
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater("nesterovs" if updater == "sgd" else updater)
+         .momentum(momentum).learning_rate(learning_rate).dtype(dtype)
+         .weight_init("RELU"))
+    gb = b.graph_builder().add_inputs("in")
+    stem = _conv_bn(gb, "stem", "in", width_base, (7, 7), (2, 2), "relu")
+    gb.add_layer("stem_pool", SubsamplingLayer(
+        kernel_size=(3, 3), stride=(2, 2), border_mode="same",
+        pooling_type="max"), stem)
+    cur = "stem_pool"
+    for stage, n_blocks in enumerate(blocks):
+        planes = width_base * (2 ** stage)
+        for i in range(n_blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            project = (i == 0)  # channel change (and/or stride) at stage entry
+            cur = _bottleneck(gb, f"s{stage}b{i}", cur, planes, stride, project)
+    gb.add_layer("head_pool", GlobalPoolingLayer(pooling_type="avg"), cur)
+    gb.add_layer("out", OutputLayer(n_out=n_classes, activation="softmax",
+                                    loss="mcxent"), "head_pool")
+    return (gb.set_outputs("out")
+            .set_input_types(InputType.convolutional(height, width, channels))
+            .build())
+
+
+def resnet50(**kw):
+    """ResNet-50 (ImageNet geometry by default)."""
+    return resnet((3, 4, 6, 3), **kw)

@@ -1,0 +1,436 @@
+"""ComputationGraph: the DAG runtime model.
+
+Parity: reference ``nn/graph/ComputationGraph.java`` — ``init`` (``:278``,
+topo sort + params), ``fit`` (``:614-760``), ``computeGradientAndScore``
+(``:912``), forward over ``topologicalOrder`` (``:1007``), ``output``
+(``:1058``); multi-input/multi-output, loss summed over all output layers.
+
+TPU-native design: the whole topo-ordered DAG forward + loss + ``jax.grad``
+backward + updater apply traces into ONE jitted XLA program (donated params).
+The reference's per-vertex ``doForward``/``doBackward`` dispatch loop has no
+runtime analog — vertex boundaries disappear into XLA fusion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as _dtypes
+from .. import losses as _losses
+from .. import rng as _rng
+from ..optimize import updaters as _updaters
+from .conf.graph import ComputationGraphConfiguration, LayerVertex
+
+Pytree = Any
+
+
+def _as_list(v) -> List[Any]:
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+class ComputationGraph:
+    """Runtime DAG network over a :class:`ComputationGraphConfiguration`."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        conf.validate()
+        self.conf = conf
+        self.training = conf.training
+        self.policy = _dtypes.policy_from_name(conf.training.dtype)
+        self.topo_order = conf.topological_order()
+        self.params: Optional[Dict[str, Dict[str, jax.Array]]] = None
+        self.state: Dict[str, Dict[str, jax.Array]] = {}
+        self.updater_state: Optional[Pytree] = None
+        self.listeners: List[Any] = []
+        self.iteration_count = 0
+        self._update_count = 0
+        self.epoch_count = 0
+        self._score = None
+        self._updater = None
+        self._jit_cache: Dict[str, Any] = {}
+
+        self._output_layer_names = [
+            n for n in conf.network_outputs
+            if hasattr(self._vertex_layer(n), "compute_score_array")]
+
+    def _vertex_layer(self, name: str):
+        v = self.conf.vertices[name]
+        return v.layer if isinstance(v, LayerVertex) else None
+
+    # ------------------------------------------------------------------
+    # init (parity: ComputationGraph.init :278)
+    # ------------------------------------------------------------------
+
+    def init(self, key: Optional[jax.Array] = None) -> "ComputationGraph":
+        if key is None:
+            key = _rng.key(self.training.seed)
+        params, state = {}, {}
+        for name in self.topo_order:
+            v = self.conf.vertices[name]
+            vk = _rng.fold_name(key, name)
+            params[name] = v.init_params(vk, self.policy)
+            state[name] = v.init_state(self.policy)
+        self.params = params
+        self.state = state
+        self._persistent_keys = {
+            name: tuple(self.conf.vertices[name].init_state(self.policy).keys())
+            for name in self.topo_order}
+        self._updater = _updaters.make_updater(
+            self.training, self._lr_multipliers())
+        self.updater_state = self._updater.init(params)
+        return self
+
+    def _lr_multipliers(self) -> Pytree:
+        base = float(self.training.learning_rate)
+        mults = {}
+        for name in self.topo_order:
+            v = self.conf.vertices[name]
+            layer = v.layer if isinstance(v, LayerVertex) else None
+            shapes = v.param_shapes(self.policy)
+            if layer is None or not shapes:
+                mults[name] = {k: 1.0 for k in shapes}
+                continue
+            layer_lr = (layer.learning_rate
+                        if layer.learning_rate is not None else base)
+            bias_lr = (layer.bias_learning_rate
+                       if layer.bias_learning_rate is not None else layer_lr)
+            if base == 0.0:
+                if layer_lr != 0.0 or bias_lr != 0.0:
+                    raise ValueError(
+                        f"vertex {name!r} sets a per-layer learning rate but "
+                        "the global learning_rate is 0.0")
+                mults[name] = {k: 1.0 for k in shapes}
+            else:
+                mults[name] = {k: (bias_lr / base if k == "b" else layer_lr / base)
+                               for k in shapes}
+        return mults
+
+    def num_params(self) -> int:
+        if self.params is None:
+            raise ValueError("call init() first")
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    # ------------------------------------------------------------------
+    # functional forward over the DAG
+    # ------------------------------------------------------------------
+
+    def _states_map(self) -> Dict[str, Dict[str, jax.Array]]:
+        return {n: dict(self.state.get(n, {})) for n in self.topo_order}
+
+    def _persist_states(self, new_states: Dict[str, Dict[str, jax.Array]]) -> None:
+        for name, keys in self._persistent_keys.items():
+            if keys:
+                self.state[name] = {
+                    k: new_states[name][k] for k in keys if k in new_states[name]}
+
+    def _forward(self, params, states, inputs: List[jax.Array], *,
+                 train: bool, rng=None, masks=None):
+        """Walk the topo order; returns ({vertex: activation}, new_states)."""
+        acts: Dict[str, jax.Array] = dict(zip(self.conf.network_inputs, inputs))
+        mask_map: Dict[str, Optional[jax.Array]] = dict(
+            zip(self.conf.network_inputs,
+                masks if masks is not None else [None] * len(inputs)))
+        new_states: Dict[str, Dict[str, jax.Array]] = {}
+        for name in self.topo_order:
+            v = self.conf.vertices[name]
+            in_names = self.conf.vertex_inputs[name]
+            xs = [acts[i] for i in in_names]
+            in_masks = [mask_map.get(i) for i in in_names]
+            vrng = None if rng is None else _rng.fold_name(rng, name)
+            out, st = v.apply(params[name], xs, state=states[name],
+                              train=train, rng=vrng, masks=in_masks,
+                              policy=self.policy)
+            acts[name] = out
+            mask_map[name] = v.output_mask(in_masks, minibatch=xs[0].shape[0])
+            new_states[name] = st if st is not None else {}
+        return acts, new_states
+
+    # ------------------------------------------------------------------
+    # inference (parity: output :1058)
+    # ------------------------------------------------------------------
+
+    def output(self, *inputs, train: bool = False):
+        """Activations of the network outputs. Returns a single array when
+        there is one output, else a list."""
+        inputs = [jnp.asarray(x) for x in _as_list(
+            inputs[0] if len(inputs) == 1 and isinstance(inputs[0], (list, tuple))
+            else list(inputs))]
+        cache_key = f"output_train={train}"
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            @jax.jit
+            def fn(params, states, inputs, rng):
+                acts, _ = self._forward(params, states, inputs,
+                                        train=train,
+                                        rng=rng if train else None)
+                return [acts[n] for n in self.conf.network_outputs]
+            self._jit_cache[cache_key] = fn
+        rng = (_rng.fold_name(_rng.key(self.training.seed),
+                              f"output_{self.iteration_count}")
+               if train else None)
+        outs = fn(self.params, self._states_map(), inputs, rng)
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *inputs, train: bool = False) -> Dict[str, jax.Array]:
+        """All vertex activations keyed by name."""
+        inputs = [jnp.asarray(x) for x in _as_list(
+            inputs[0] if len(inputs) == 1 and isinstance(inputs[0], (list, tuple))
+            else list(inputs))]
+        acts, _ = self._forward(self.params, self._states_map(), inputs,
+                                train=train)
+        return acts
+
+    # ------------------------------------------------------------------
+    # loss (parity: computeGradientAndScore :912 — score summed over outputs)
+    # ------------------------------------------------------------------
+
+    def _loss_fn(self, params, states, inputs, labels, masks, rng):
+        if not self._output_layer_names:
+            raise ValueError(
+                "no output vertex has a loss (need OutputLayer/RnnOutputLayer/"
+                "LossLayer at a network output to train)")
+        # forward everything EXCEPT the output-layer vertices' own apply;
+        # for those we need the hidden input to compute_score_array
+        out_set = set(self._output_layer_names)
+        acts: Dict[str, jax.Array] = dict(zip(self.conf.network_inputs, inputs))
+        mask_map: Dict[str, Optional[jax.Array]] = dict(
+            zip(self.conf.network_inputs,
+                masks if masks is not None else [None] * len(inputs)))
+        new_states: Dict[str, Dict[str, jax.Array]] = {}
+        label_map = dict(zip(self.conf.network_outputs, labels))
+        total = 0.0
+        denom_total = 0.0
+        for name in self.topo_order:
+            v = self.conf.vertices[name]
+            in_names = self.conf.vertex_inputs[name]
+            xs = [acts[i] for i in in_names]
+            in_masks = [mask_map.get(i) for i in in_names]
+            vrng = None if rng is None else _rng.fold_name(rng, name)
+            if name in out_set:
+                layer = v.layer
+                hidden = xs[0]
+                out_mask = in_masks[0] if in_masks else None
+                if v.preprocessor is not None:
+                    mb = hidden.shape[0]
+                    hidden = v.preprocessor(hidden, minibatch_size=mb)
+                    out_mask = v.preprocessor.transform_mask(
+                        out_mask, minibatch_size=mb)
+                y = label_map[name]
+                score_arr = layer.compute_score_array(
+                    params[name], hidden, y, mask=out_mask, policy=self.policy)
+                denom = _losses.masked_denominator(out_mask, y,
+                                                  score_arr.shape[0])
+                total = total + jnp.sum(score_arr) / denom
+                new_states[name] = {}
+            else:
+                out, st = v.apply(params[name], xs, state=states[name],
+                                  train=True, rng=vrng, masks=in_masks,
+                                  policy=self.policy)
+                acts[name] = out
+                mask_map[name] = v.output_mask(in_masks,
+                                               minibatch=xs[0].shape[0])
+                new_states[name] = st if st is not None else {}
+        total = total + self._reg_penalty(params)
+        loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
+                      else jnp.float32)
+        return total.astype(loss_dtype), new_states
+
+    def _reg_penalty(self, params):
+        if not self.training.regularization:
+            return 0.0
+        acc_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
+                     else jnp.float32)
+        total = 0.0
+        for name in self.topo_order:
+            layer = self._vertex_layer(name)
+            if layer is None:
+                continue
+            l1 = float(layer.l1 or 0.0)
+            l2 = float(layer.l2 or 0.0)
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            lp = params[name]
+            for pname in layer.regularized_params():
+                if pname not in lp:
+                    continue
+                w = lp[pname].astype(acc_dtype)
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(w))
+                if l2:
+                    total = total + 0.5 * l2 * jnp.sum(jnp.square(w))
+        return total
+
+    def score_for(self, inputs, labels, masks=None) -> float:
+        inputs = [jnp.asarray(x) for x in _as_list(inputs)]
+        labels = [jnp.asarray(y) for y in _as_list(labels)]
+        if masks is not None:
+            masks = [None if m is None else jnp.asarray(m)
+                     for m in _as_list(masks)]
+        loss, _ = self._loss_fn(self.params, self._states_map(), inputs,
+                                labels, masks, None)
+        return float(loss)
+
+    def score(self) -> Optional[float]:
+        if self._score is None:
+            return None
+        self._score = float(self._score)
+        return self._score
+
+    # ------------------------------------------------------------------
+    # the jitted train step + fit
+    # ------------------------------------------------------------------
+
+    def _make_train_step(self):
+        t = self.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = self._updater
+
+        def step(params, opt_state, states, inputs, labels, masks, rng, it):
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(
+                    params, states, inputs, labels, masks, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, it)
+            params = _updaters.apply_updates(params, deltas)
+            return params, opt_state, new_states, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _train_step(self):
+        fn = self._jit_cache.get("train_step")
+        if fn is None:
+            fn = self._make_train_step()
+            self._jit_cache["train_step"] = fn
+        return fn
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def _make_train_scan(self):
+        """K train steps fused into ONE lax.scan XLA program (same design as
+        MultiLayerNetwork._make_train_scan)."""
+        t = self.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = self._updater
+
+        def one(carry, batch):
+            params, opt_state, states, it = carry
+            xs, ys, masks, rng = batch
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(
+                    params, states, xs, ys, masks, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, it)
+            params = _updaters.apply_updates(params, deltas)
+            kept = {name: {k: new_states[name].get(k, v)
+                           for k, v in st_old.items()}
+                    for name, st_old in states.items()}
+            return (params, opt_state, kept, it + 1), loss
+
+        def scan_steps(params, opt_state, states, xs, ys, masks, rngs, it0):
+            (params, opt_state, states, _), losses = jax.lax.scan(
+                one, (params, opt_state, states, it0), (xs, ys, masks, rngs))
+            return params, opt_state, states, losses
+
+        return jax.jit(scan_steps, donate_argnums=(0, 1))
+
+    def fit_scan(self, xs, ys, masks=None):
+        """Train on K pre-staged batches in one dispatch. xs/ys: [k, b, ...]
+        arrays or lists of such (multi-input/multi-output); returns [k] losses."""
+        xs = [jnp.asarray(a) for a in _as_list(xs)]
+        ys = [jnp.asarray(a) for a in _as_list(ys)]
+        k = xs[0].shape[0]
+        if masks is not None:
+            masks = [None if m is None else jnp.asarray(m)
+                     for m in _as_list(masks)]
+        fn = self._jit_cache.get("train_scan")
+        if fn is None:
+            fn = self._make_train_scan()
+            self._jit_cache["train_scan"] = fn
+        base = _rng.key(self.training.seed)
+        rngs = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(self._update_count, self._update_count + k))
+        it0 = jnp.asarray(self._update_count, jnp.int32)
+        params, opt_state, new_states, losses = fn(
+            self.params, self.updater_state, self._states_map(), xs, ys,
+            masks, rngs, it0)
+        self.params = params
+        self.updater_state = opt_state
+        self._update_count += k
+        self._persist_states(new_states)
+        self._score = losses[-1]
+        self.iteration_count += k
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration_count, losses[-1])
+        return losses
+
+    def fit_batch(self, inputs, labels, masks=None):
+        """One update. inputs/labels: array or list of arrays (multi-input /
+        multi-output); masks: optional list of feature masks."""
+        inputs = [jnp.asarray(x) for x in _as_list(inputs)]
+        labels = [jnp.asarray(y) for y in _as_list(labels)]
+        if masks is not None:
+            masks = [None if m is None else jnp.asarray(m)
+                     for m in _as_list(masks)]
+        rng = _rng.fold_name(_rng.key(self.training.seed),
+                             f"update_{self._update_count}")
+        it = jnp.asarray(self._update_count, jnp.int32)
+        params, opt_state, new_states, loss = self._train_step()(
+            self.params, self.updater_state, self._states_map(), inputs,
+            labels, masks, rng, it)
+        self.params = params
+        self.updater_state = opt_state
+        self._update_count += 1
+        self._persist_states(new_states)
+        self._score = loss
+        self.iteration_count += 1
+        for l in self.listeners:
+            if hasattr(l, "record_batch"):
+                l.record_batch(inputs[0].shape[0])
+            l.iteration_done(self, self.iteration_count, loss)
+        return loss
+
+    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+        """Train from (inputs, labels), a DataSet/MultiDataSet, or an iterator
+        of either (parity: fit variants :614-760)."""
+        if self.params is None:
+            self.init()
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self, self.epoch_count)
+            for ins, outs, masks in self._as_batches(data, labels):
+                self.fit_batch(ins, outs, masks)
+            for l in self.listeners:
+                l.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+            if hasattr(data, "reset"):
+                data.reset()
+
+    @staticmethod
+    def _as_batches(data, labels=None, mask=None):
+        from ..util.batching import iter_batches
+        return iter_batches(data, labels, mask)
+
+    # ------------------------------------------------------------------
+    # evaluation bridge
+    # ------------------------------------------------------------------
+
+    def evaluate(self, data, labels=None):
+        from ..eval import Evaluation
+        ev = Evaluation()
+        for x, y, m in self._as_batches(data, labels):
+            out = self.output(jnp.asarray(np.asarray(x)))
+            ev.eval(np.asarray(y), np.asarray(out),
+                    mask=None if m is None else np.asarray(m))
+        if hasattr(data, "reset"):
+            data.reset()
+        return ev
+
+    def clone_params(self):
+        return jax.tree_util.tree_map(lambda p: jnp.array(p), self.params)

@@ -436,30 +436,9 @@ class MultiLayerNetwork:
                 data.reset()
 
     @staticmethod
-    def _as_batches(data, labels, mask):
-        if labels is not None:
-            yield (data, labels, mask)
-            return
-        if hasattr(data, "features"):
-            yield (data.features, data.labels,
-                   getattr(data, "features_mask", None))
-            return
-        # the documented fit((features, labels)) tuple form: a 2/3-tuple of
-        # arrays is ONE batch, not an iterator of batches
-        if (isinstance(data, tuple) and len(data) in (2, 3)
-                and all(hasattr(a, "shape") or a is None for a in data)):
-            x, y = data[0], data[1]
-            m = data[2] if len(data) > 2 else mask
-            yield (x, y, m)
-            return
-        for item in data:
-            if hasattr(item, "features"):
-                yield (item.features, item.labels,
-                       getattr(item, "features_mask", None))
-            else:
-                x, y = item[0], item[1]
-                m = item[2] if len(item) > 2 else None
-                yield (x, y, m)
+    def _as_batches(data, labels=None, mask=None):
+        from ..util.batching import iter_batches
+        return iter_batches(data, labels, mask)
 
     def fit_batch(self, x, y, mask=None) -> float:
         """One minibatch update (tbptt-aware). Returns the score."""

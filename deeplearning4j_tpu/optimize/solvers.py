@@ -1,0 +1,191 @@
+"""Convex optimizers: LBFGS, conjugate gradient, line gradient descent.
+
+Parity: reference ``optimize/Solver.java:41-48`` (dispatch on
+``OptimizationAlgorithm``), ``solvers/StochasticGradientDescent.java``,
+``LBFGS.java``, ``ConjugateGradient.java``, ``LineGradientDescent.java``,
+``BackTrackLineSearch.java``.
+
+TPU-native design: these are full-batch deterministic optimizers over the
+*flattened* parameter vector (``ravel_pytree``), with the loss+grad evaluated
+as one jitted program. The minibatch path (the reference's SGD solver +
+updaters) lives in the network runtimes; these solvers cover the reference's
+second-order/line-search surface (used for small-data full-batch fits).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (parity: ``BackTrackLineSearch.java`` — step
+    halving until sufficient decrease, maxIterations bounded)."""
+
+    def __init__(self, c1: float = 1e-4, shrink: float = 0.5,
+                 max_iterations: int = 5):
+        self.c1 = float(c1)
+        self.shrink = float(shrink)
+        self.max_iterations = int(max_iterations)
+
+    def search(self, f, x: jnp.ndarray, fx: float, g: jnp.ndarray,
+               direction: jnp.ndarray, initial_step: float = 1.0
+               ) -> Tuple[float, float]:
+        """Returns (step, f(x + step*direction))."""
+        slope = float(jnp.vdot(g, direction))
+        step = initial_step
+        best_step, best_val = 0.0, fx
+        for _ in range(self.max_iterations):
+            val = float(f(x + step * direction))
+            if val <= fx + self.c1 * step * slope:
+                return step, val
+            if val < best_val:
+                best_step, best_val = step, val
+            step *= self.shrink
+        return best_step, best_val
+
+
+class Solver:
+    """Full-batch solver over a network + one batch (parity: ``Solver.java``).
+
+    Usage::
+
+        Solver(net).optimize(x, y, iterations=50)   # algo from conf
+
+    The algorithm comes from ``conf.training.optimization_algo``:
+    ``"lbfgs" | "conjugate_gradient" | "line_gradient_descent"``
+    (``"sgd"`` delegates to the network's own minibatch fit).
+    """
+
+    def __init__(self, net, algo: Optional[str] = None,
+                 memory: int = 10, line_search: Optional[BackTrackLineSearch] = None):
+        self.net = net
+        self.algo = (algo or net.training.optimization_algo or "sgd").lower()
+        self.memory = int(memory)
+        self.line_search = line_search or BackTrackLineSearch(
+            max_iterations=getattr(net.training, "max_line_search_iterations", 5))
+
+    def _flat_loss(self, x, y, mask=None):
+        net = self.net
+        states = net._states_list() if hasattr(net, "_states_list") \
+            else net._states_map()
+        flat0, unravel = ravel_pytree(net.params)
+
+        if hasattr(net, "_states_list"):
+            def loss_tree(params):
+                val, _ = net._loss_fn(params, states, x, y, mask, None)
+                return val
+        else:
+            gmasks = None if mask is None else [mask]
+            def loss_tree(params):
+                val, _ = net._loss_fn(params, states, [x], [y], gmasks, None)
+                return val
+
+        loss_flat = jax.jit(lambda v: loss_tree(unravel(v)))
+        grad_flat = jax.jit(jax.grad(lambda v: loss_tree(unravel(v))))
+        return flat0, unravel, loss_flat, grad_flat
+
+    def optimize(self, x, y, mask=None, iterations: Optional[int] = None,
+                 tolerance: float = 1e-8) -> float:
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        iters = iterations or self.net.training.iterations or 10
+        if self.algo in ("sgd", "stochastic_gradient_descent"):
+            loss = None
+            for _ in range(iters):
+                loss = self.net.fit_batch(x, y, mask)
+            return float(loss)
+        flat0, unravel, f, g = self._flat_loss(x, y, mask)
+        if self.algo == "lbfgs":
+            final, score = self._lbfgs(flat0, f, g, iters, tolerance)
+        elif self.algo in ("cg", "conjugate_gradient"):
+            final, score = self._cg(flat0, f, g, iters, tolerance)
+        elif self.algo in ("line_gradient_descent", "linegd"):
+            final, score = self._line_gd(flat0, f, g, iters, tolerance)
+        else:
+            raise ValueError(f"unknown optimization algorithm {self.algo!r}")
+        self.net.params = unravel(final)
+        self.net._score = score
+        return float(score)
+
+    # ---- algorithms ----
+
+    def _line_gd(self, x0, f, g, iters, tol):
+        x = x0
+        fx = float(f(x))
+        for _ in range(iters):
+            grad = g(x)
+            step, fnew = self.line_search.search(f, x, fx, grad, -grad,
+                                                 initial_step=1.0)
+            if step == 0.0 or abs(fx - fnew) < tol:
+                break
+            x = x - step * grad
+            fx = fnew
+        return x, fx
+
+    def _cg(self, x0, f, g, iters, tol):
+        """Polak-Ribière nonlinear CG with restart (parity:
+        ``ConjugateGradient.java``)."""
+        x = x0
+        fx = float(f(x))
+        grad = g(x)
+        direction = -grad
+        for _ in range(iters):
+            step, fnew = self.line_search.search(f, x, fx, grad, direction,
+                                                 initial_step=1.0)
+            if step == 0.0 or abs(fx - fnew) < tol:
+                break
+            x = x + step * direction
+            new_grad = g(x)
+            beta = float(jnp.vdot(new_grad, new_grad - grad)
+                         / jnp.maximum(jnp.vdot(grad, grad), 1e-30))
+            beta = max(0.0, beta)  # PR+ restart
+            direction = -new_grad + beta * direction
+            if float(jnp.vdot(direction, new_grad)) > 0:  # not a descent dir
+                direction = -new_grad
+            grad, fx = new_grad, fnew
+        return x, fx
+
+    def _lbfgs(self, x0, f, g, iters, tol):
+        """Two-loop-recursion L-BFGS (parity: ``LBFGS.java``, memory m)."""
+        m = self.memory
+        x = x0
+        fx = float(f(x))
+        grad = g(x)
+        s_hist: List[jnp.ndarray] = []
+        y_hist: List[jnp.ndarray] = []
+        for _ in range(iters):
+            # two-loop recursion for H·g
+            q = grad
+            alphas = []
+            for s, yv in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / float(jnp.maximum(jnp.vdot(yv, s), 1e-30))
+                a = rho * float(jnp.vdot(s, q))
+                alphas.append((a, rho, s, yv))
+                q = q - a * yv
+            if y_hist:
+                s_last, y_last = s_hist[-1], y_hist[-1]
+                gamma = float(jnp.vdot(s_last, y_last)
+                              / jnp.maximum(jnp.vdot(y_last, y_last), 1e-30))
+                q = gamma * q
+            for a, rho, s, yv in reversed(alphas):
+                b = rho * float(jnp.vdot(yv, q))
+                q = q + (a - b) * s
+            direction = -q
+            step, fnew = self.line_search.search(f, x, fx, grad, direction,
+                                                 initial_step=1.0)
+            if step == 0.0 or abs(fx - fnew) < tol:
+                break
+            x_new = x + step * direction
+            new_grad = g(x_new)
+            s_hist.append(x_new - x)
+            y_hist.append(new_grad - grad)
+            if len(s_hist) > m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            x, grad, fx = x_new, new_grad, fnew
+        return x, fx

@@ -1,0 +1,44 @@
+"""Shared batch-iteration helper used by both network runtimes and the
+early-stopping trainer (single source of truth for the DataSet / tuple /
+iterator dispatch)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+
+def iter_batches(data, labels=None, mask=None) -> Iterator[Tuple]:
+    """Yield (features, labels, features_mask) triples.
+
+    `data` may be: (features, labels[, mask]) arrays; a DataSet (has
+    .features/.labels); or an iterator yielding DataSets or tuples.
+    """
+    if labels is not None:
+        yield (data, labels, mask)
+        return
+    if hasattr(data, "features"):
+        yield (data.features, data.labels,
+               getattr(data, "features_mask", None))
+        return
+    # a 2/3-tuple of arrays — or of lists of arrays (multi-input graphs) —
+    # is ONE batch, not an iterator of batches
+    def _batchlike(a):
+        if a is None or hasattr(a, "shape"):
+            return True
+        return (isinstance(a, list) and len(a) > 0
+                and all(hasattr(e, "shape") or e is None for e in a))
+
+    if (isinstance(data, tuple) and len(data) in (2, 3)
+            and all(_batchlike(a) for a in data)):
+        x, y = data[0], data[1]
+        m = data[2] if len(data) > 2 else mask
+        yield (x, y, m)
+        return
+    for item in data:
+        if hasattr(item, "features"):
+            yield (item.features, item.labels,
+                   getattr(item, "features_mask", None))
+        else:
+            x, y = item[0], item[1]
+            m = item[2] if len(item) > 2 else None
+            yield (x, y, m)

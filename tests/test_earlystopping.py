@@ -1,0 +1,117 @@
+"""Early stopping tests (parity model: reference TestEarlyStopping.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    EvaluationScoreCalculator, InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    MaxTimeTerminationCondition, ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net(lr=0.05):
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("adam")
+            .learning_rate(lr).list()
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iters(rng, n=96, batch=32):
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    w = rng.normal(size=(6, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return (ArrayDataSetIterator(x[:64], y[:64], batch),
+            ArrayDataSetIterator(x[64:], y[64:], batch))
+
+
+class TestEarlyStopping:
+    def test_max_epochs_terminates(self, rng):
+        train, test = _iters(rng)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(test))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+               .build())
+        result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+        assert result.total_epochs == 5
+        assert result.termination_reason == "epoch_condition"
+        assert "MaxEpochs" in result.termination_details
+        assert result.best_model is not None
+        assert len(result.score_vs_epoch) == 5
+
+    def test_best_model_is_restored(self, rng):
+        train, test = _iters(rng)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(test))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+               .build())
+        result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+        best = result.best_model
+        score = DataSetLossCalculator(test).calculate_score(best)
+        assert score == pytest.approx(result.best_model_score, rel=1e-5)
+
+    def test_score_improvement_patience(self, rng):
+        train, test = _iters(rng)
+        # lr=0 → score never improves → patience triggers after 2 stale epochs
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(test))
+               .epoch_termination_conditions(
+                   ScoreImprovementEpochTerminationCondition(2),
+                   MaxEpochsTerminationCondition(50))
+               .build())
+        result = EarlyStoppingTrainer(cfg, _net(lr=0.0), train).fit()
+        assert result.termination_reason == "epoch_condition"
+        assert "ScoreImprovement" in result.termination_details
+        assert result.total_epochs <= 4
+
+    def test_max_time_terminates_immediately(self, rng):
+        train, test = _iters(rng)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(test))
+               .iteration_termination_conditions(MaxTimeTerminationCondition(0.0))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(100))
+               .build())
+        result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+        assert result.termination_reason == "iteration_condition"
+        assert "MaxTime" in result.termination_details
+
+    def test_max_score_abort(self, rng):
+        train, test = _iters(rng)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(test))
+               .iteration_termination_conditions(
+                   MaxScoreIterationTerminationCondition(1e-9))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(100))
+               .build())
+        result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+        assert result.termination_reason == "iteration_condition"
+
+    def test_local_file_saver(self, rng, tmp_path):
+        train, test = _iters(rng)
+        saver = LocalFileModelSaver(str(tmp_path))
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(test))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+               .model_saver(saver).save_last_model(True)
+               .build())
+        result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+        assert (tmp_path / "bestModel.zip").exists()
+        assert (tmp_path / "latestModel.zip").exists()
+        assert result.best_model is not None
+
+    def test_evaluation_score_calculator(self, rng):
+        train, test = _iters(rng)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(EvaluationScoreCalculator(test))
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+               .build())
+        result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+        assert 0.0 <= result.best_model_score <= 1.0

@@ -1,0 +1,346 @@
+"""ComputationGraph config + runtime tests.
+
+Parity model: reference ComputationGraphConfigurationTest, TestComputationGraphNetwork,
+GradientCheckTestsComputationGraph.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.gradientcheck import check_graph_gradients
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import (
+    ComputationGraphConfiguration, ElementWiseVertex, L2NormalizeVertex,
+    L2Vertex, LastTimeStepVertex, MergeVertex, ScaleVertex, StackVertex,
+    SubsetVertex, UnstackVertex)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, OutputLayer,
+    SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.recurrent import GravesLSTM
+from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+
+def _base(updater="adam", lr=1e-2):
+    return (NeuralNetConfiguration.builder().seed(42)
+            .updater(updater).learning_rate(lr))
+
+
+def _class_labels(rng, n, c):
+    return np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+
+
+class TestGraphConfig:
+    def test_builder_and_topo(self):
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "d1")
+                .add_vertex("sum", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "sum")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(5))
+                .build())
+        order = conf.topological_order()
+        assert order.index("d1") < order.index("d2")
+        assert order.index("d2") < order.index("sum")
+        assert order.index("sum") < order.index("out")
+        # nIn inference ran
+        assert conf.vertices["d1"].layer.n_in == 5
+        assert conf.vertices["out"].layer.n_in == 8
+
+    def test_cycle_detection(self):
+        with pytest.raises(ValueError, match="cycle"):
+            ComputationGraphConfiguration(
+                vertices={"a": ElementWiseVertex(), "b": ElementWiseVertex()},
+                vertex_inputs={"a": ["b"], "b": ["a"]},
+                network_inputs=["in"], network_outputs=["a"],
+            ).topological_order()
+
+    def test_unknown_input_raises(self):
+        with pytest.raises(ValueError, match="unknown input"):
+            ComputationGraphConfiguration(
+                vertices={"a": ElementWiseVertex()},
+                vertex_inputs={"a": ["nope"]},
+                network_inputs=["in"], network_outputs=["a"],
+            ).validate()
+
+    def test_json_roundtrip(self):
+        conf = (_base().graph_builder()
+                .add_inputs("x1", "x2")
+                .add_layer("d1", DenseLayer(n_out=4, activation="tanh"), "x1")
+                .add_layer("d2", DenseLayer(n_out=4, activation="tanh"), "x2")
+                .add_vertex("merged", MergeVertex(), "d1", "d2")
+                .add_vertex("sub", SubsetVertex(from_idx=0, to_idx=3), "merged")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "sub")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(3),
+                                 InputType.feed_forward(3))
+                .build())
+        j = conf.to_json()
+        back = ComputationGraphConfiguration.from_json(j)
+        assert back.to_json() == j
+        assert back.vertices["d1"].layer.n_in == 3
+        assert back.vertex_inputs["merged"] == ["d1", "d2"]
+
+    def test_graph_builder_reachable_from_nn_builder(self):
+        gb = NeuralNetConfiguration.builder().graph_builder()
+        assert gb is not None
+
+
+class TestGraphRuntime:
+    def test_residual_dense_trains(self, rng):
+        x = rng.normal(size=(32, 6)).astype(np.float32)
+        w = rng.normal(size=(6, 3))
+        y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=6, activation="relu"), "in")
+                .add_vertex("res", ElementWiseVertex(op="add"), "d1", "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "res")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(6))
+                .build())
+        net = ComputationGraph(conf).init()
+        s0 = net.score_for(x, y)
+        for _ in range(40):
+            net.fit_batch(x, y)
+        assert net.score() < s0 * 0.5
+        out = np.asarray(net.output(x))
+        assert out.shape == (32, 3)
+        assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_multi_input(self, rng):
+        xa = rng.normal(size=(16, 4)).astype(np.float32)
+        xb = rng.normal(size=(16, 3)).astype(np.float32)
+        y = _class_labels(rng, 16, 2)
+        conf = (_base().graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_out=5, activation="tanh"), "a")
+                .add_layer("db", DenseLayer(n_out=5, activation="tanh"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(3))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert conf.vertices["out"].layer.n_in == 10
+        s0 = net.score_for([xa, xb], [y])
+        for _ in range(30):
+            net.fit_batch([xa, xb], [y])
+        assert net.score() < s0
+
+    def test_multi_output(self, rng):
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y1 = _class_labels(rng, 16, 2)
+        y2 = rng.normal(size=(16, 3)).astype(np.float32)
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("trunk", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("cls", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "trunk")
+                .add_layer("reg", OutputLayer(n_out=3, activation="identity",
+                                              loss="mse"), "trunk")
+                .set_outputs("cls", "reg")
+                .set_input_types(InputType.feed_forward(5))
+                .build())
+        net = ComputationGraph(conf).init()
+        for _ in range(20):
+            net.fit_batch([x], [y1, y2])
+        outs = net.output(x)
+        assert len(outs) == 2
+        assert outs[0].shape == (16, 2) and outs[1].shape == (16, 3)
+
+    def test_lstm_last_time_step_vertex(self, rng):
+        x = rng.normal(size=(8, 6, 4)).astype(np.float32)
+        y = _class_labels(rng, 8, 2)
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_out=5, activation="tanh"), "in")
+                .add_vertex("last", LastTimeStepVertex(), "lstm")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "last")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        for _ in range(10):
+            net.fit_batch(x, y)
+        assert np.asarray(net.output(x)).shape == (8, 2)
+
+    def test_small_resnet_block_trains(self, rng):
+        """Conv → BN → relu → conv → BN + skip → relu → pool → out."""
+        x = rng.normal(size=(8, 8, 8, 3)).astype(np.float32)
+        y = _class_labels(rng, 8, 4)
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("conv1", ConvolutionLayer(
+                    n_out=8, kernel_size=(3, 3), border_mode="same",
+                    activation="identity"), "in")
+                .add_layer("bn1", BatchNormalization(activation="relu"), "conv1")
+                .add_layer("conv2", ConvolutionLayer(
+                    n_out=8, kernel_size=(3, 3), border_mode="same",
+                    activation="identity"), "bn1")
+                .add_layer("bn2", BatchNormalization(), "conv2")
+                .add_layer("proj", ConvolutionLayer(
+                    n_out=8, kernel_size=(1, 1), activation="identity"), "in")
+                .add_vertex("res", ElementWiseVertex(op="add"), "bn2", "proj")
+                .add_layer("pool", SubsamplingLayer(
+                    kernel_size=(8, 8), stride=(8, 8), pooling_type="avg"), "res")
+                .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                              loss="mcxent"), "pool")
+                .set_outputs("out")
+                .set_input_types(InputType.convolutional(8, 8, 3))
+                .build())
+        net = ComputationGraph(conf).init()
+        s0 = net.score_for(x, y)
+        for _ in range(30):
+            net.fit_batch(x, y)
+        assert net.score() < s0
+
+    def test_stack_unstack_shared_tower(self, rng):
+        xa = rng.normal(size=(8, 4)).astype(np.float32)
+        xb = rng.normal(size=(8, 4)).astype(np.float32)
+        y = rng.uniform(size=(8, 1)).astype(np.float32)
+        conf = (_base().graph_builder()
+                .add_inputs("a", "b")
+                .add_vertex("stacked", StackVertex(), "a", "b")
+                .add_layer("tower", DenseLayer(n_out=6, activation="tanh"),
+                           "stacked")
+                .add_vertex("ua", UnstackVertex(from_idx=0, stack_size=2), "tower")
+                .add_vertex("ub", UnstackVertex(from_idx=1, stack_size=2), "tower")
+                .add_vertex("dist", L2Vertex(), "ua", "ub")
+                .add_layer("out", OutputLayer(n_out=1, activation="sigmoid",
+                                              loss="xent"), "dist")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        for _ in range(5):
+            net.fit_batch([xa, xb], [y])
+        assert np.asarray(net.output([xa, xb])).shape == (8, 1)
+
+    def test_evaluate(self, rng):
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = _class_labels(rng, 32, 2)
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4)).build())
+        net = ComputationGraph(conf).init()
+        for _ in range(50):
+            net.fit_batch(x, y)
+        ev = net.evaluate(x, y)
+        assert ev.accuracy() > 0.8
+
+
+class TestGraphGradients:
+    def test_residual_block_gradcheck(self, rng):
+        x = rng.normal(size=(4, 5))
+        y = np.eye(3)[rng.integers(0, 3, 4)]
+        conf = (_base("sgd", 0.1).graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_vertex("res", ElementWiseVertex(op="add"), "d1", "in")
+                .add_vertex("scaled", ScaleVertex(scale=0.5), "res")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "scaled")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(5)).build())
+        r = check_graph_gradients(conf, [x], [y], max_rel_error=1e-5)
+        assert r.passed, r.summary()
+
+    def test_merge_multi_input_gradcheck(self, rng):
+        xa, xb = rng.normal(size=(4, 3)), rng.normal(size=(4, 2))
+        y = np.eye(2)[rng.integers(0, 2, 4)]
+        conf = (_base("sgd", 0.1).graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_out=4, activation="tanh"), "a")
+                .add_layer("db", DenseLayer(n_out=4, activation="sigmoid"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_vertex("norm", L2NormalizeVertex(), "m")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "norm")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(3),
+                                 InputType.feed_forward(2)).build())
+        r = check_graph_gradients(conf, [xa, xb], [y], max_rel_error=1e-5)
+        assert r.passed, r.summary()
+
+    def test_multi_output_gradcheck(self, rng):
+        x = rng.normal(size=(4, 4))
+        y1 = np.eye(2)[rng.integers(0, 2, 4)]
+        y2 = rng.normal(size=(4, 2))
+        conf = (_base("sgd", 0.1).graph_builder()
+                .add_inputs("in")
+                .add_layer("t", DenseLayer(n_out=6, activation="tanh"), "in")
+                .add_layer("c", OutputLayer(n_out=2, activation="softmax",
+                                            loss="mcxent"), "t")
+                .add_layer("r", OutputLayer(n_out=2, activation="identity",
+                                            loss="mse"), "t")
+                .set_outputs("c", "r")
+                .set_input_types(InputType.feed_forward(4)).build())
+        r = check_graph_gradients(conf, [x], [y1, y2], max_rel_error=1e-5)
+        assert r.passed, r.summary()
+
+
+class TestGraphSerialization:
+    def test_checkpoint_roundtrip(self, rng, tmp_path):
+        from deeplearning4j_tpu.util import ModelSerializer, load_model
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = _class_labels(rng, 8, 2)
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=6, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4)).build())
+        net = ComputationGraph(conf).init()
+        net.fit_batch(x, y)
+        p = str(tmp_path / "graph.zip")
+        ModelSerializer.write_model(net, p)
+        restored = load_model(p)
+        assert type(restored).__name__ == "ComputationGraph"
+        assert np.allclose(np.asarray(net.output(x)),
+                           np.asarray(restored.output(x)), atol=1e-6)
+
+    def test_exact_resume(self, rng, tmp_path):
+        from deeplearning4j_tpu.util import ModelSerializer
+        import jax
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = _class_labels(rng, 8, 2)
+
+        def make():
+            conf = (_base("adam", 1e-2).graph_builder()
+                    .add_inputs("in")
+                    .add_layer("d", DenseLayer(n_out=6, activation="tanh"), "in")
+                    .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                                  loss="mcxent"), "d")
+                    .set_outputs("out")
+                    .set_input_types(InputType.feed_forward(4)).build())
+            return ComputationGraph(conf).init()
+
+        ref = make()
+        for _ in range(8):
+            ref.fit_batch(x, y)
+        net = make()
+        for _ in range(3):
+            net.fit_batch(x, y)
+        p = str(tmp_path / "g.zip")
+        ModelSerializer.write_model(net, p, save_updater=True)
+        resumed = ModelSerializer.restore_computation_graph(p)
+        for _ in range(5):
+            resumed.fit_batch(x, y)
+        for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                        jax.tree_util.tree_leaves(resumed.params)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)

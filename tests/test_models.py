@@ -1,0 +1,71 @@
+"""Model zoo tests: configs build, shapes infer, small variants train."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import char_rnn_lstm, lenet, resnet, resnet50
+from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class TestLenet:
+    def test_builds_and_infers(self):
+        conf = lenet()
+        net = MultiLayerNetwork(conf).init()
+        # conv1 20@5x5x1 + b, conv2 50@5x5x20 + b, dense 800x500 + b, out 500x10 + b
+        expect = (5 * 5 * 1 * 20 + 20) + (5 * 5 * 20 * 50 + 50) \
+            + (4 * 4 * 50 * 500 + 500) + (500 * 10 + 10)
+        assert net.num_params() == expect
+
+    def test_forward_shape(self, rng):
+        net = MultiLayerNetwork(lenet()).init()
+        out = np.asarray(net.output(rng.normal(size=(4, 784)).astype(np.float32)))
+        assert out.shape == (4, 10)
+
+
+class TestResNet:
+    def test_resnet50_builds(self):
+        conf = resnet50(dtype="float32")
+        net = ComputationGraph(conf).init()
+        n = net.num_params()
+        # ResNet-50 ImageNet: ~25.6M params
+        assert 25_000_000 < n < 26_000_000, n
+
+    def test_tiny_resnet_trains(self, rng):
+        conf = resnet((1, 1), height=16, width=16, channels=3, n_classes=4,
+                      width_base=8, dtype="float32", learning_rate=0.01)
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(8, 16, 16, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        s0 = net.score_for([x], [y])
+        for _ in range(15):
+            net.fit_batch(x, y)
+        assert net.score() < s0
+        assert np.asarray(net.output(x)).shape == (8, 4)
+
+    def test_stage_downsampling_shapes(self):
+        conf = resnet((1, 1), height=32, width=32, channels=3, n_classes=10,
+                      width_base=8, dtype="float32")
+        types = conf.infer_shapes()
+        # stem /2, pool /2, stage1 /2 → 32/8 = 4
+        assert types["s1b0_relu"].height == 4
+        assert types["s1b0_relu"].channels == 8 * 2 * 4
+
+
+class TestCharRnn:
+    def test_builds_and_tbptt(self, rng):
+        conf = char_rnn_lstm(vocab_size=12, hidden=8, layers=2,
+                             tbptt_length=5)
+        assert conf.backprop_type == "truncated_bptt"
+        net = MultiLayerNetwork(conf).init()
+        x = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (4, 12))]
+        y = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (4, 12))]
+        net.fit_batch(x, y)  # 12 steps > tbptt 5 → chunked path
+        assert np.isfinite(net.score())
+
+    def test_streaming_inference(self, rng):
+        conf = char_rnn_lstm(vocab_size=8, hidden=8, layers=1)
+        net = MultiLayerNetwork(conf).init()
+        step1 = net.rnn_time_step(np.eye(8, dtype=np.float32)[[0, 1]])
+        step2 = net.rnn_time_step(np.eye(8, dtype=np.float32)[[2, 3]])
+        assert step1.shape == (2, 8) and step2.shape == (2, 8)
