@@ -1,0 +1,40 @@
+"""NLP / embeddings stack.
+
+Parity: reference ``deeplearning4j-nlp-parent`` (~34k LoC) —
+``SequenceVectors.java:161`` (the generic embedding trainer),
+``SkipGram.java:216`` / ``CBOW.java`` (learning algorithms), ``Word2Vec``,
+``ParagraphVectors`` (``inferVector``), ``Glove``, vocab
+(``AbstractCache``, ``VocabConstructor``), Huffman tree, tokenization +
+sentence iterators, and ``WordVectorSerializer`` formats.
+
+TPU-native design (NOT a port): the reference trains embeddings with
+lock-free multithreaded per-word gemv updates (Hogwild,
+``SequenceVectors.java:245-260``). Here the host side only *prepares index
+batches* — (center, context/code-path, negatives) int arrays — and ONE jitted
+step per batch does the whole update vectorized: ``jnp.take`` gathers,
+fused sigmoid-dot losses, ``jax.grad``, and ``segment_sum`` scatter-adds.
+Negative sampling and hierarchical softmax are both expressed this way; the
+random-window/subsampling logic runs in numpy on host.
+"""
+
+from .glove import Glove
+from .paragraph_vectors import ParagraphVectors
+from .sentence_iterator import (
+    BasicLineIterator, CollectionSentenceIterator, FileSentenceIterator,
+    SentenceIterator)
+from .sequence_vectors import SequenceVectors
+from .tokenization import (
+    DefaultTokenizer, DefaultTokenizerFactory, NGramTokenizerFactory,
+    CommonPreprocessor)
+from .vocab import Huffman, VocabCache, VocabWord
+from .word2vec import Word2Vec, WordVectorSerializer
+
+__all__ = [
+    "Word2Vec", "ParagraphVectors", "Glove", "SequenceVectors",
+    "VocabCache", "VocabWord", "Huffman",
+    "DefaultTokenizer", "DefaultTokenizerFactory", "NGramTokenizerFactory",
+    "CommonPreprocessor",
+    "SentenceIterator", "BasicLineIterator", "CollectionSentenceIterator",
+    "FileSentenceIterator",
+    "WordVectorSerializer",
+]

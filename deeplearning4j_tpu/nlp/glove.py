@@ -1,0 +1,122 @@
+"""GloVe: global-vector embeddings from co-occurrence statistics.
+
+Parity: reference ``models/glove/Glove.java`` (+ ``glove/count/`` co-occurrence
+counting): weighted least squares  f(X_ij)(w_i·w̃_j + b_i + b̃_j − log X_ij)²
+with AdaGrad per-parameter learning rates.
+
+TPU-native: co-occurrence counting is a host-side dict sweep; training is a
+jitted AdaGrad step over shuffled (i, j, X_ij) triples — gathers + grads →
+scatter-add, like the word2vec steps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .sequence_vectors import SequenceVectors
+from .vocab import VocabConstructor
+
+
+@functools.partial(__import__("jax").jit, donate_argnums=(0, 1))
+def _glove_step(params, accum, rows, cols, logx, weight, lr):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(p):
+        wi = jnp.take(p["w"], rows, axis=0)
+        wj = jnp.take(p["w_tilde"], cols, axis=0)
+        bi = jnp.take(p["b"], rows)
+        bj = jnp.take(p["b_tilde"], cols)
+        diff = jnp.sum(wi * wj, axis=1) + bi + bj - logx
+        return 0.5 * jnp.sum(weight * diff * diff) / rows.shape[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    # AdaGrad: accumulate squared grads, scale updates
+    accum = jax.tree_util.tree_map(lambda a, g: a + g * g, accum, grads)
+    params = jax.tree_util.tree_map(
+        lambda p, g, a: p - lr * g / jnp.sqrt(a + 1e-12), params, grads, accum)
+    return params, accum, loss
+
+
+class Glove(SequenceVectors):
+    """GloVe trainer (reference builder knobs: ``xMax``, ``alpha``,
+    ``symmetric``, ``shuffle``, ``learningRate``, ``epochs``)."""
+
+    def __init__(self, *, x_max: float = 100.0, alpha: float = 0.75,
+                 symmetric: bool = True, shuffle: bool = True, **kw):
+        kw.setdefault("learning_rate", 0.05)
+        super().__init__(**kw)
+        self.x_max = float(x_max)
+        self.alpha = float(alpha)
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self._accum = None
+
+    def _count_cooccurrences(self, seqs: Iterable[List[int]]
+                             ) -> Dict[Tuple[int, int], float]:
+        counts: Dict[Tuple[int, int], float] = {}
+        W = self.window
+        for idx in seqs:
+            n = len(idx)
+            for pos in range(n):
+                for off in range(1, W + 1):
+                    j = pos + off
+                    if j >= n:
+                        break
+                    a, b = int(idx[pos]), int(idx[j])
+                    inc = 1.0 / off  # distance weighting (GloVe convention)
+                    counts[(a, b)] = counts.get((a, b), 0.0) + inc
+                    if self.symmetric:
+                        counts[(b, a)] = counts.get((b, a), 0.0) + inc
+        return counts
+
+    def fit(self, sequences: Iterable[List[str]],
+            resettable: bool = True) -> "Glove":
+        import jax.numpy as jnp
+
+        seqs = list(sequences)
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        indexed = []
+        for seq in seqs:
+            idx = [self.vocab.index_of(t) for t in seq]
+            indexed.append([i for i in idx if i >= 0])
+        counts = self._count_cooccurrences(indexed)
+        if not counts:
+            raise ValueError("empty co-occurrence matrix")
+        pairs = np.array(list(counts.keys()), dtype=np.int32)
+        xs = np.array(list(counts.values()), dtype=np.float32)
+        logx = np.log(xs)
+        weight = np.minimum((xs / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        init = lambda shape: jnp.asarray(
+            (rng.random(shape, dtype=np.float32) - 0.5) / D)
+        self.params = {"w": init((V, D)), "w_tilde": init((V, D)),
+                       "b": jnp.zeros(V, jnp.float32),
+                       "b_tilde": jnp.zeros(V, jnp.float32)}
+        self._accum = __import__("jax").tree_util.tree_map(
+            jnp.zeros_like, self.params)
+
+        B = self.batch_size
+        order = np.arange(len(pairs))
+        for _ in range(self.epochs):
+            if self.shuffle:
+                rng.shuffle(order)
+            for s in range(0, len(order), B):
+                sel = order[s:s + B]
+                self.params, self._accum, _ = _glove_step(
+                    self.params, self._accum,
+                    jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
+                    jnp.asarray(logx[sel]), jnp.asarray(weight[sel]),
+                    jnp.float32(self.learning_rate))
+        self._syn0_normed = None
+        return self
+
+    def _syn0(self) -> np.ndarray:
+        # GloVe convention: final embedding = w + w̃
+        return np.asarray(self.params["w"]) + np.asarray(self.params["w_tilde"])

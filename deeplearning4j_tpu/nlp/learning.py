@@ -1,0 +1,126 @@
+"""Embedding learning algorithms: SkipGram / CBOW × negative-sampling / HS.
+
+Parity: reference ``models/embeddings/learning/impl/elements/SkipGram.java:216``
+(``iterateSample`` — per-word HS dot/gradient loop + negative sampling) and
+``CBOW.java``.
+
+TPU-native design: one jitted SGD step per index batch. ``jnp.take`` gathers
+rows; differentiating the gather makes XLA emit scatter-adds — the vectorized
+equivalent of the reference's per-word axpy updates, with the whole batch's
+forward+backward fused into one XLA program. The unigram^0.75 negative table
+and window/subsampling logic stay host-side (numpy) in sequence_vectors.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_params(vocab_size: int, dim: int, seed: int = 42,
+                hs_nodes: int = 0, use_neg: bool = True,
+                extra_vectors: int = 0) -> Dict[str, jnp.ndarray]:
+    """syn0 ~ U(-0.5/dim, 0.5/dim) (word2vec convention); output tables zero.
+
+    extra_vectors: additional rows in syn0 beyond the vocab (ParagraphVectors
+    doc vectors live there).
+    """
+    rng = np.random.default_rng(seed)
+    rows = vocab_size + extra_vectors
+    params = {"syn0": jnp.asarray(
+        (rng.random((rows, dim), dtype=np.float32) - 0.5) / dim)}
+    if use_neg:
+        params["syn1neg"] = jnp.zeros((vocab_size, dim), jnp.float32)
+    if hs_nodes > 0:
+        params["syn1"] = jnp.zeros((hs_nodes, dim), jnp.float32)
+    return params
+
+
+# ----------------------------------------------------------------------
+# loss terms (shared by skip-gram and CBOW: they differ only in how the
+# input vector v is formed)
+# ----------------------------------------------------------------------
+
+
+def _ns_loss(params, v, target, negs):
+    """Negative-sampling loss for input vectors v [B,D] against target word
+    ids [B] and negatives [B,K]."""
+    u_pos = jnp.take(params["syn1neg"], target, axis=0)        # [B,D]
+    u_neg = jnp.take(params["syn1neg"], negs, axis=0)          # [B,K,D]
+    pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, axis=-1))
+    neg = jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", v, u_neg))
+    return -(jnp.sum(pos) + jnp.sum(neg))
+
+
+def _hs_loss(params, v, codes, points, code_mask):
+    """Hierarchical-softmax loss: codes/points [B,L] (padded), mask [B,L]."""
+    u = jnp.take(params["syn1"], points, axis=0)               # [B,L,D]
+    dots = jnp.einsum("bd,bld->bl", v, u)
+    # code 0 → predict sigmoid→1, code 1 → 0 (word2vec convention)
+    sign = 1.0 - 2.0 * codes.astype(v.dtype)
+    logp = jax.nn.log_sigmoid(sign * dots) * code_mask
+    return -jnp.sum(logp)
+
+
+# ----------------------------------------------------------------------
+# jitted steps
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("cbow",))
+def ns_step(params, center, target, negs, ctx, ctx_mask, lr, *, cbow=False):
+    """One SGD step, negative sampling.
+
+    skip-gram: v = syn0[center];  cbow: v = masked mean of syn0[ctx].
+    center/target [B], negs [B,K], ctx [B,W], ctx_mask [B,W].
+    """
+    def loss_fn(p):
+        if cbow:
+            vecs = jnp.take(p["syn0"], ctx, axis=0)            # [B,W,D]
+            m = ctx_mask[..., None]
+            v = jnp.sum(vecs * m, axis=1) / jnp.maximum(
+                jnp.sum(m, axis=1), 1.0)
+        else:
+            v = jnp.take(p["syn0"], center, axis=0)
+        # SUM (not mean): each pair takes a full lr-sized step, matching the
+        # reference/word2vec per-sample SGD semantics (colliding rows
+        # accumulate, the batched analog of sequential updates)
+        return _ns_loss(p, v, target, negs)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss / center.shape[0]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("cbow",))
+def hs_step(params, center, codes, points, code_mask, ctx, ctx_mask, lr, *,
+            cbow=False):
+    """One SGD step, hierarchical softmax. codes/points/mask [B,L]."""
+    def loss_fn(p):
+        if cbow:
+            vecs = jnp.take(p["syn0"], ctx, axis=0)
+            m = ctx_mask[..., None]
+            v = jnp.sum(vecs * m, axis=1) / jnp.maximum(
+                jnp.sum(m, axis=1), 1.0)
+        else:
+            v = jnp.take(p["syn0"], center, axis=0)
+        return _hs_loss(p, v, codes, points, code_mask)  # sum: see ns_step
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss / center.shape[0]
+
+
+def build_unigram_table(counts: np.ndarray, power: float = 0.75,
+                        table_size: int = 1 << 20) -> np.ndarray:
+    """word2vec's unigram^0.75 negative-sampling table (parity: the
+    ``table`` in the reference's SkipGram negative sampling)."""
+    probs = counts.astype(np.float64) ** power
+    probs /= probs.sum()
+    return np.searchsorted(np.cumsum(probs),
+                           (np.arange(table_size) + 0.5) / table_size
+                           ).astype(np.int32)

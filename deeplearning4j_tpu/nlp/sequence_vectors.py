@@ -1,0 +1,252 @@
+"""SequenceVectors: the generic embedding trainer over token sequences.
+
+Parity: reference ``models/sequencevectors/SequenceVectors.java:161``
+(``fit()``: vocab build → training threads → per-sequence ``trainSequence``)
+with the Hogwild thread pool (``:245-260``) replaced by host-side batch
+preparation + jitted vectorized update steps (see learning.py).
+
+Also the base for Word2Vec / ParagraphVectors / DeepWalk, exactly as in the
+reference's class hierarchy.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import learning as _learning
+from .vocab import Huffman, VocabCache, VocabConstructor
+
+
+class SequenceVectors:
+    """Train word/sequence embeddings from an iterable of token sequences.
+
+    Key hyperparameters mirror the reference builder: ``layer_size``
+    (vector dim), ``window``, ``negative`` (0 → hierarchical softmax),
+    ``min_word_frequency``, ``sample`` (frequent-word subsampling),
+    ``learning_rate``/``min_learning_rate`` (linear decay), ``epochs``,
+    ``use_cbow`` (elements algo: skip-gram default), ``seed``.
+    """
+
+    def __init__(self, *, layer_size: int = 100, window: int = 5,
+                 negative: int = 5, min_word_frequency: int = 1,
+                 sample: float = 0.0, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, epochs: int = 1,
+                 batch_size: int = 4096, use_cbow: bool = False,
+                 seed: int = 42, vocab_limit: Optional[int] = None):
+        self.layer_size = layer_size
+        self.window = window
+        self.negative = negative
+        self.min_word_frequency = min_word_frequency
+        self.sample = sample
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.use_cbow = use_cbow
+        self.seed = seed
+        self.vocab_limit = vocab_limit
+
+        self.vocab: Optional[VocabCache] = None
+        self.params: Optional[Dict] = None
+        self._codes = self._points = self._lengths = None
+        self._neg_table: Optional[np.ndarray] = None
+        self._syn0_normed: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    # vocab + fit
+    # ------------------------------------------------------------------
+
+    def build_vocab(self, sequences: Iterable[List[str]]) -> None:
+        self.vocab = VocabConstructor(
+            self.min_word_frequency, self.vocab_limit).build(sequences)
+        if self.negative <= 0:
+            h = Huffman(self.vocab)
+            h.apply()
+            self._codes, self._points, self._lengths = h.padded_tables()
+        else:
+            self._neg_table = _learning.build_unigram_table(
+                self.vocab.counts_array())
+
+    def _init_params(self, extra_vectors: int = 0) -> None:
+        V = self.vocab.num_words()
+        self.params = _learning.init_params(
+            V, self.layer_size, seed=self.seed,
+            hs_nodes=(V - 1 if self.negative <= 0 else 0),
+            use_neg=self.negative > 0,
+            extra_vectors=extra_vectors)
+
+    def fit(self, sequences: Iterable[List[str]],
+            resettable: bool = True) -> "SequenceVectors":
+        """Build vocab (if absent) + train. For multiple epochs `sequences`
+        must be re-iterable (e.g. a list or SentenceIterator)."""
+        seqs = sequences if not hasattr(sequences, "__next__") else list(sequences)
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        if self.params is None:
+            self._init_params()
+        self._train(seqs)
+        self._syn0_normed = None
+        return self
+
+    # ------------------------------------------------------------------
+    # training loop: host-side batching + jitted steps
+    # ------------------------------------------------------------------
+
+    def _indexed(self, seqs: Iterable[List[str]], rng: np.random.Generator
+                 ) -> Iterable[np.ndarray]:
+        """Token sequences → filtered index arrays (+ subsampling)."""
+        vocab = self.vocab
+        total = max(vocab.total_word_count, 1)
+        sample = self.sample
+        for seq in seqs:
+            idx = [vocab.index_of(t) for t in seq]
+            idx = np.array([i for i in idx if i >= 0], dtype=np.int32)
+            if sample > 0 and len(idx):
+                freqs = vocab.counts_array()[idx] / total
+                # word2vec subsampling keep probability
+                keep_p = np.minimum(
+                    (np.sqrt(freqs / sample) + 1) * sample / freqs, 1.0)
+                idx = idx[rng.random(len(idx)) < keep_p]
+            if len(idx) >= 2:
+                yield idx
+
+    def _pairs(self, seqs, rng) -> Iterable[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+        """Yield (center, target, ctx, ctx_mask) batches. For skip-gram the
+        (center→target) pairs; for CBOW ctx is the padded window."""
+        W = self.window
+        centers, targets, ctxs, masks = [], [], [], []
+        B = self.batch_size
+        for idx in self._indexed(seqs, rng):
+            n = len(idx)
+            red = rng.integers(1, W + 1, size=n)  # reduced window per position
+            for pos in range(n):
+                b = red[pos]
+                lo, hi = max(0, pos - b), min(n, pos + b + 1)
+                window_ids = [idx[j] for j in range(lo, hi) if j != pos]
+                if not window_ids:
+                    continue
+                if self.use_cbow:
+                    ctx = np.zeros(2 * W, dtype=np.int32)
+                    m = np.zeros(2 * W, dtype=np.float32)
+                    ctx[:len(window_ids)] = window_ids
+                    m[:len(window_ids)] = 1.0
+                    centers.append(idx[pos])
+                    targets.append(idx[pos])
+                    ctxs.append(ctx)
+                    masks.append(m)
+                else:
+                    for w in window_ids:
+                        centers.append(idx[pos])
+                        targets.append(w)
+                if len(centers) >= B:
+                    yield self._emit(centers, targets, ctxs, masks)
+                    centers, targets, ctxs, masks = [], [], [], []
+        if centers:
+            yield self._emit(centers, targets, ctxs, masks)
+
+    def _emit(self, centers, targets, ctxs, masks):
+        c = np.asarray(centers, dtype=np.int32)
+        t = np.asarray(targets, dtype=np.int32)
+        if self.use_cbow:
+            return c, t, np.stack(ctxs), np.stack(masks)
+        z = np.zeros((len(c), 1), dtype=np.int32)
+        return c, t, z, np.ones((len(c), 1), dtype=np.float32)
+
+    def _train(self, seqs) -> None:
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(self.seed)
+        # one pass to count total batches for the linear LR decay
+        approx_total = None
+        step_i = 0
+        for epoch in range(self.epochs):
+            for batch in self._pairs(seqs, rng):
+                center, target, ctx, ctx_mask = batch
+                frac = (step_i / approx_total) if approx_total else \
+                    (epoch / max(self.epochs, 1))
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - frac))
+                if self.negative > 0:
+                    negs = self._draw_negatives(rng, target)
+                    self.params, _ = _learning.ns_step(
+                        self.params, jnp.asarray(center), jnp.asarray(target),
+                        jnp.asarray(negs), jnp.asarray(ctx),
+                        jnp.asarray(ctx_mask), jnp.float32(lr),
+                        cbow=self.use_cbow)
+                else:
+                    codes = self._codes[target]
+                    points = self._points[target]
+                    L = self._lengths[target]
+                    cmask = (np.arange(codes.shape[1])[None, :]
+                             < L[:, None]).astype(np.float32)
+                    self.params, _ = _learning.hs_step(
+                        self.params, jnp.asarray(center), jnp.asarray(codes),
+                        jnp.asarray(points), jnp.asarray(cmask),
+                        jnp.asarray(ctx), jnp.asarray(ctx_mask),
+                        jnp.float32(lr), cbow=self.use_cbow)
+                step_i += 1
+            if approx_total is None:
+                approx_total = max(step_i * self.epochs, 1)
+
+    def _draw_negatives(self, rng, target: np.ndarray) -> np.ndarray:
+        K = self.negative
+        draws = self._neg_table[
+            rng.integers(0, len(self._neg_table), size=(len(target), K))]
+        # avoid sampling the positive target (word2vec redraws; we remap to a
+        # random other word which is equivalent in expectation)
+        clash = draws == target[:, None]
+        if clash.any():
+            draws = np.where(clash, (draws + 1) % self.vocab.num_words(), draws)
+        return draws.astype(np.int32)
+
+    # ------------------------------------------------------------------
+    # lookup API (parity: WordVectors/BasicModelUtils)
+    # ------------------------------------------------------------------
+
+    def _syn0(self) -> np.ndarray:
+        return np.asarray(self.params["syn0"])[:self.vocab.num_words()]
+
+    def _normed(self) -> np.ndarray:
+        if self._syn0_normed is None:
+            s = self._syn0()
+            n = np.linalg.norm(s, axis=1, keepdims=True)
+            self._syn0_normed = s / np.maximum(n, 1e-12)
+        return self._syn0_normed
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.index_of(word) >= 0
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self._syn0()[i]
+
+    def similarity(self, a: str, b: str) -> float:
+        ia, ib = self.vocab.index_of(a), self.vocab.index_of(b)
+        if ia < 0 or ib < 0:
+            return float("nan")
+        n = self._normed()
+        return float(n[ia] @ n[ib])
+
+    def words_nearest(self, word_or_vec, top: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            i = self.vocab.index_of(word_or_vec)
+            if i < 0:
+                return []
+            vec = self._normed()[i]
+            exclude = {i}
+        else:
+            vec = np.asarray(word_or_vec, dtype=np.float32)
+            vec = vec / max(np.linalg.norm(vec), 1e-12)
+            exclude = set()
+        sims = self._normed() @ vec
+        order = np.argsort(-sims)
+        out = []
+        for j in order:
+            if j in exclude:
+                continue
+            out.append(self.vocab.word_for(int(j)))
+            if len(out) >= top:
+                break
+        return out

@@ -541,9 +541,14 @@ class BatchNormalization(Layer):
         if not state:
             state = self.init_state(policy)
         axes = tuple(range(x.ndim - 1))  # all but channel
+        # statistics accumulate in the state dtype (f32 under mixed policy)
+        # but the normalize+scale math stays in the activation dtype so
+        # bf16 activations don't get promoted to f32 between conv blocks
+        stat_dtype = state["mean"].dtype
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            xs = x.astype(stat_dtype)
+            mean = jnp.mean(xs, axis=axes)
+            var = jnp.var(xs, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -551,12 +556,16 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xn = (x - mean) / jnp.sqrt(var + self.eps)
+        inv = jax.lax.rsqrt(var + self.eps)
         if self.lock_gamma_beta:
-            y = self.gamma * xn + self.beta
+            scale = (self.gamma * inv).astype(x.dtype)
+            shift = (self.beta - self.gamma * mean * inv).astype(x.dtype)
         else:
-            y = params["gamma"] * xn + params["beta"]
-        return y, new_state
+            g32 = params["gamma"].astype(stat_dtype)
+            b32 = params["beta"].astype(stat_dtype)
+            scale = (g32 * inv).astype(x.dtype)
+            shift = (b32 - g32 * mean * inv).astype(x.dtype)
+        return x * scale + shift, new_state
 
 
 @register_layer("lrn")

@@ -1,0 +1,97 @@
+"""Graph package tests (parity model: reference TestGraph, TestGraphLoading,
+DeepWalkGradientCheck / TestDeepWalk — two-cluster barbell graph separates)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, GraphLoader, RandomWalkIterator,
+    WeightedRandomWalkIterator)
+
+
+def _barbell(n_per_side=8):
+    """Two dense clusters joined by a single bridge edge."""
+    g = Graph(2 * n_per_side)
+    for base in (0, n_per_side):
+        for i in range(n_per_side):
+            for j in range(i + 1, n_per_side):
+                g.add_edge(base + i, base + j)
+    g.add_edge(n_per_side - 1, n_per_side)  # bridge
+    return g
+
+
+class TestGraphStructure:
+    def test_add_edge_and_neighbors(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, weight=2.0)
+        assert g.neighbors(1) == [0, 2]
+        assert g.degree(1) == 2
+        assert g.num_edges() == 2
+        assert g.neighbors_weighted(1)[1] == (2, 2.0)
+
+    def test_directed(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1)
+        assert g.neighbors(0) == [1]
+        assert g.neighbors(1) == []
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(ValueError):
+            Graph(2).add_edge(0, 5)
+
+
+class TestLoader:
+    def test_edge_list_file(self, tmp_path):
+        p = tmp_path / "edges.csv"
+        p.write_text("# comment\n0,1\n1,2,3.5\n")
+        g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 3)
+        assert g.num_edges() == 2
+        assert g.neighbors_weighted(1)[1] == (2, 3.5)
+
+
+class TestWalks:
+    def test_walk_shape_and_validity(self):
+        g = _barbell(4)
+        walks = list(RandomWalkIterator(g, walk_length=10, seed=0))
+        assert len(walks) == 8
+        for w in walks:
+            assert len(w) == 10
+            for a, b in zip(w, w[1:]):
+                assert b in g.neighbors(a) or a == b
+
+    def test_disconnected_self_loops(self):
+        g = Graph(2)  # no edges
+        walks = list(RandomWalkIterator(g, walk_length=5, seed=0))
+        for w in walks:
+            assert len(set(w)) == 1  # stays put
+
+    def test_weighted_walk_prefers_heavy_edges(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1, weight=100.0)
+        g.add_edge(0, 2, weight=0.001)
+        it = WeightedRandomWalkIterator(g, walk_length=2, seed=1,
+                                        walks_per_vertex=50)
+        seconds = [w[1] for w in it if w[0] == 0]
+        assert seconds.count(1) > seconds.count(2)
+
+
+class TestDeepWalk:
+    def test_clusters_separate(self):
+        g = _barbell(8)
+        dw = DeepWalk(vector_size=16, window_size=4, walk_length=20,
+                      walks_per_vertex=8, epochs=2, seed=3,
+                      batch_size=1024).fit(g)
+        # same-cluster similarity beats cross-cluster
+        same = dw.similarity(0, 1)
+        cross = dw.similarity(0, 12)
+        assert same > cross, (same, cross)
+        near = dw.verticies_nearest(2, top=5)
+        same_cluster_hits = sum(1 for v in near if v < 8)
+        assert same_cluster_hits >= 3, near
+
+    def test_vertex_vector_shape(self):
+        g = _barbell(4)
+        dw = DeepWalk(vector_size=12, walk_length=10, epochs=1,
+                      seed=4).fit(g)
+        assert dw.get_vertex_vector(0).shape == (12,)

@@ -1,0 +1,195 @@
+"""NLP stack tests.
+
+Parity model: reference nlp test suites — Word2VecTests (wordsNearest('day')
+contains 'night'-style similarity sanity checks on a synthetic corpus),
+tokenization tests, vocab tests, serde round-trips, ParagraphVectors
+inferVector, GloVe.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, Glove, Huffman, NGramTokenizerFactory,
+    ParagraphVectors, SequenceVectors, VocabCache, Word2Vec,
+    WordVectorSerializer)
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+def _synthetic_corpus(n=400, seed=0):
+    """Two topic clusters (20 words each): words within a cluster co-occur,
+    across clusters they don't. 'cat'/'dog' belong to the animal cluster,
+    'car'/'road' to the vehicle cluster."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "pet", "fur", "tail"] + \
+        [f"animal{i}" for i in range(15)]
+    vehicles = ["car", "road", "wheel", "drive", "engine"] + \
+        [f"vehicle{i}" for i in range(15)]
+    out = []
+    for _ in range(n):
+        cluster = animals if rng.random() < 0.5 else vehicles
+        out.append(list(rng.choice(cluster, size=8)))
+    return out
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        toks = DefaultTokenizerFactory().create("Hello world foo").get_tokens()
+        assert toks == ["Hello", "world", "foo"]
+
+    def test_common_preprocessor(self):
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        toks = tf.create('Hello, World! 42 "quoted"').get_tokens()
+        assert toks == ["hello", "world", "quoted"]
+
+    def test_ngrams(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a_b", "b_c"]
+
+
+class TestSentenceIterators:
+    def test_collection(self):
+        it = CollectionSentenceIterator(["one", " two ", "", "three"])
+        assert list(it) == ["one", "two", "three"]
+
+    def test_basic_line(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("line one\n\nline two\n")
+        it = BasicLineIterator(str(p))
+        assert list(it) == ["line one", "line two"]
+        assert list(it) == ["line one", "line two"]  # re-iterable
+
+
+class TestVocab:
+    def test_build_filter_and_index(self):
+        vocab = VocabConstructor(min_word_frequency=2).build(
+            [["a", "a", "a", "b", "b", "c"]])
+        assert vocab.num_words() == 2
+        assert vocab.index_of("a") == 0  # most frequent first
+        assert vocab.index_of("b") == 1
+        assert vocab.index_of("c") == -1
+        assert vocab.word_frequency("a") == 3
+
+    def test_huffman_codes(self):
+        vocab = VocabConstructor().build(
+            [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]])
+        h = Huffman(vocab)
+        max_len = h.apply()
+        words = vocab.vocab_words()
+        # frequent words get shorter codes
+        assert len(words[0].codes) <= len(words[-1].codes)
+        assert max_len >= 2
+        # prefix-free: no code is a prefix of another
+        codes = ["".join(map(str, w.codes)) for w in words]
+        for i, a in enumerate(codes):
+            for j, b in enumerate(codes):
+                if i != j:
+                    assert not b.startswith(a)
+        # all inner-node indices < V-1
+        codes_t, points_t, lengths = h.padded_tables()
+        assert points_t.max() < vocab.num_words() - 1 + 1
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("negative", [5, 0])  # ns and hs
+    def test_clusters_separate(self, negative):
+        corpus = _synthetic_corpus()
+        sv = SequenceVectors(layer_size=32, window=3, negative=negative,
+                             epochs=3, seed=1, batch_size=1024)
+        sv.fit(corpus)
+        assert sv.similarity("cat", "dog") > sv.similarity("cat", "car") + 0.1
+        near = sv.words_nearest("cat", top=5)
+        hits = sum(1 for w in near
+                   if str(w) in ("dog", "pet", "fur", "tail")
+                   or str(w).startswith("animal"))
+        assert hits >= 4, near
+
+    def test_cbow(self):
+        corpus = _synthetic_corpus()
+        sv = SequenceVectors(layer_size=32, window=3, negative=5,
+                             epochs=6, use_cbow=True, seed=2, batch_size=1024)
+        sv.fit(corpus)
+        near = sv.words_nearest("car", top=5)
+        hits = sum(1 for w in near
+                   if str(w) in ("road", "wheel", "drive", "engine")
+                   or str(w).startswith("vehicle"))
+        assert hits >= 4, near
+
+    def test_builder_api_and_sentence_pipeline(self):
+        sentences = [" ".join(s) for s in _synthetic_corpus(100)]
+        w2v = (Word2Vec.builder()
+               .layer_size(16).window_size(3).min_word_frequency(1)
+               .negative_sample(5).epochs(2).seed(3)
+               .iterate(CollectionSentenceIterator(sentences))
+               .tokenizer_factory(DefaultTokenizerFactory())
+               .build())
+        w2v.fit()
+        assert w2v.has_word("cat")
+        assert 0 < len(w2v.words_nearest("cat", top=3)) <= 3
+        assert w2v.get_word_vector("cat").shape == (16,)
+
+    def test_serde_roundtrip(self, tmp_path):
+        corpus = _synthetic_corpus(50)
+        sv = SequenceVectors(layer_size=12, epochs=1, seed=4).fit(corpus)
+        p = str(tmp_path / "vecs.txt")
+        WordVectorSerializer.write_word_vectors(sv, p)
+        loaded = WordVectorSerializer.load_txt_vectors(p)
+        for w in ["cat", "car"]:
+            assert np.allclose(loaded.get_word_vector(w),
+                               sv.get_word_vector(w), atol=1e-5)
+
+    def test_subsampling_runs(self):
+        corpus = _synthetic_corpus(50)
+        sv = SequenceVectors(layer_size=8, sample=1e-3, epochs=1, seed=5)
+        sv.fit(corpus)
+        assert sv.vocab.num_words() == 40
+
+
+class TestParagraphVectors:
+    def _docs(self, n=60, seed=0):
+        rng = np.random.default_rng(seed)
+        animals = ["cat", "dog", "pet", "fur", "tail"] + \
+            [f"animal{i}" for i in range(15)]
+        vehicles = ["car", "road", "wheel", "drive", "engine"] + \
+            [f"vehicle{i}" for i in range(15)]
+        docs = []
+        for i in range(n):
+            cluster, tag = (animals, "animal") if i % 2 == 0 else (vehicles, "vehicle")
+            docs.append((f"{tag}_{i}", list(rng.choice(cluster, size=12))))
+        return docs
+
+    @pytest.mark.parametrize("dm", [False, True])
+    def test_doc_vectors_cluster(self, dm):
+        docs = self._docs()
+        pv = ParagraphVectors(layer_size=24, window=3, epochs=30, seed=6,
+                              dm=dm, batch_size=1024)
+        pv.fit_documents(docs)
+        va = pv.get_paragraph_vector("animal_0")
+        vb = pv.get_paragraph_vector("animal_2")
+        vc = pv.get_paragraph_vector("vehicle_1")
+        cos = lambda a, b: float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+        assert cos(va, vb) > cos(va, vc)
+
+    def test_infer_vector_nearest_labels(self):
+        docs = self._docs()
+        pv = ParagraphVectors(layer_size=24, window=3, epochs=30, seed=7,
+                              batch_size=1024)
+        pv.fit_documents(docs)
+        near = pv.nearest_labels(["cat", "dog", "pet", "fur"], top=5)
+        animal_hits = sum(1 for l in near if l.startswith("animal"))
+        assert animal_hits >= 3, near
+
+
+class TestGlove:
+    def test_clusters_separate(self):
+        corpus = _synthetic_corpus(300)
+        gl = Glove(layer_size=24, window=3, epochs=30, seed=8,
+                   learning_rate=0.05)
+        gl.fit(corpus)
+        assert gl.similarity("cat", "dog") > gl.similarity("cat", "car")
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            Glove(layer_size=8).fit([[]])
