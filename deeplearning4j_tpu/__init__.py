@@ -24,6 +24,7 @@ __version__ = "0.1.0"
 _SUBMODULES = {
     "nn", "optimize", "eval", "datasets", "parallel", "models", "nlp",
     "graph", "modelimport", "ui", "util", "ops", "losses", "dtypes", "rng",
+    "earlystopping", "clustering", "plot", "storage", "gradientcheck",
 }
 
 

@@ -1,0 +1,18 @@
+"""Clustering + spatial search structures.
+
+Parity: reference ``deeplearning4j-core/.../clustering/`` — ``kmeans/``
+(KMeansClustering over the generic ``BaseClusteringAlgorithm``),
+``kdtree/KDTree.java``, ``vptree/VPTree.java`` (nearest-neighbour search),
+``sptree/``/``quadtree/`` (used by Barnes-Hut t-SNE, see ``plot/``).
+
+TPU-native: KMeans assignment/update are jitted all-pairs distance programs
+(one XLA program per iteration — the MXU eats the [n, k] distance matmul);
+the tree structures are host-side numpy (pointer-chasing search does not
+belong on a systolic array).
+"""
+
+from .kdtree import KDTree
+from .kmeans import KMeansClustering
+from .vptree import VPTree
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree"]

@@ -1,0 +1,98 @@
+"""KMeans (parity: reference ``clustering/kmeans/KMeansClustering.java`` over
+``algorithm/BaseClusteringAlgorithm.java`` — iterative assign/update with a
+distance function and convergence condition).
+
+TPU-native: k-means++ seeding on host; each iteration is ONE jitted program:
+[n,k] squared-distance matrix via the ||a-b||² = ||a||²+||b||²-2ab expansion
+(MXU matmul), argmin assignment, segment-sum centroid update.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _sq_dists(x, c):
+    import jax.numpy as jnp
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)
+    return x2 + c2 - 2.0 * (x @ c.T)
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("k",))
+def _kmeans_iter(x, centroids, *, k):
+    import jax
+    import jax.numpy as jnp
+    d = _sq_dists(x, centroids)
+    assign = jnp.argmin(d, axis=1)
+    one_hot = jax.nn.one_hot(assign, k, dtype=x.dtype)
+    counts = jnp.sum(one_hot, axis=0)
+    sums = one_hot.T @ x
+    new_c = jnp.where(counts[:, None] > 0,
+                      sums / jnp.maximum(counts[:, None], 1.0),
+                      centroids)
+    cost = jnp.sum(jnp.min(d, axis=1))
+    return new_c, assign, cost
+
+
+class KMeansClustering:
+    """Usage (reference: ``KMeansClustering.setup(k, maxIter, distance)``)::
+
+        km = KMeansClustering(k=3, max_iterations=100, seed=0)
+        assignments = km.fit(points).predict(points)
+    """
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 tolerance: float = 1e-6, seed: Optional[int] = None):
+        self.k = int(k)
+        self.max_iterations = int(max_iterations)
+        self.tolerance = float(tolerance)
+        self.seed = seed
+        self.centroids: Optional[np.ndarray] = None
+        self.cost: Optional[float] = None
+        self.iterations_run = 0
+
+    def _kmeanspp_init(self, x: np.ndarray, rng) -> np.ndarray:
+        n = x.shape[0]
+        centroids = [x[rng.integers(0, n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                ((x[:, None, :] - np.stack(centroids)[None]) ** 2).sum(-1),
+                axis=1)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centroids.append(x[rng.choice(n, p=probs)])
+        return np.stack(centroids)
+
+    def fit(self, points) -> "KMeansClustering":
+        import jax.numpy as jnp
+
+        x = np.asarray(points, dtype=np.float32)
+        if x.shape[0] < self.k:
+            raise ValueError(f"need at least k={self.k} points, got {x.shape[0]}")
+        rng = np.random.default_rng(self.seed)
+        c = jnp.asarray(self._kmeanspp_init(x, rng))
+        xj = jnp.asarray(x)
+        prev_cost = None
+        for it in range(self.max_iterations):
+            c, _, cost = _kmeans_iter(xj, c, k=self.k)
+            cost = float(cost)
+            self.iterations_run = it + 1
+            if prev_cost is not None and abs(prev_cost - cost) <= \
+                    self.tolerance * max(abs(prev_cost), 1.0):
+                prev_cost = cost
+                break
+            prev_cost = cost
+        self.centroids = np.asarray(c)
+        self.cost = prev_cost
+        return self
+
+    def predict(self, points) -> np.ndarray:
+        import jax.numpy as jnp
+        if self.centroids is None:
+            raise ValueError("fit() first")
+        d = _sq_dists(jnp.asarray(np.asarray(points, np.float32)),
+                      jnp.asarray(self.centroids))
+        return np.asarray(jnp.argmin(d, axis=1))

@@ -1,0 +1,18 @@
+"""Keras model import.
+
+Parity: reference ``deeplearning4j-modelimport`` —
+``keras/Model.java:58`` (``importSequentialModel``), ``:78``
+(``importFunctionalApiModel``), ``ModelConfiguration.java`` (config JSON →
+network configuration), ``LayerConfiguration.java:42-47`` (supported layers:
+Dense, TimeDistributedDense, LSTM, Convolution2D, MaxPooling2D, Flatten,
+Dropout, Activation + the activation-name mapping).
+
+TPU-native: HDF5 read via h5py (replacing JavaCPP hdf5 bindings); weights are
+transposed into this framework's conventions (NHWC/HWIO convs; [in, out]
+dense kernels — Keras already stores those layouts, the reference had to
+transpose into its own NCHW/F-order world, we mostly do NOT).
+"""
+
+from .keras import KerasModelImport
+
+__all__ = ["KerasModelImport"]

@@ -1,0 +1,358 @@
+"""Keras HDF5 importer.
+
+Parity: reference ``keras/Model.java`` / ``ModelConfiguration.java`` /
+``LayerConfiguration.java``. Reads a Keras-saved ``.h5`` archive: the
+``model_config`` JSON attribute picks the architecture, ``model_weights``
+holds per-layer arrays. Supports Keras 1.x and 2.x sequential configs and
+linear/residual functional graphs.
+
+Supported layers (superset of the reference's ``LayerConfiguration.java:42``):
+Dense, Activation, Dropout, Flatten, Convolution2D/Conv2D, MaxPooling2D,
+AveragePooling2D, LSTM, Embedding, BatchNormalization.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.conf.builders import NeuralNetConfiguration
+from ..nn.conf.inputs import InputType
+from ..nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, OutputLayer, SubsamplingLayer)
+from ..nn.conf.recurrent import GravesLSTM, LastTimeStepLayer
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "softmax": "softmax",
+    "sigmoid": "sigmoid", "tanh": "tanh", "hard_sigmoid": "hardsigmoid",
+    "softplus": "softplus", "elu": "elu", "selu": "selu",
+    "softsign": "softsign", "leaky_relu": "leakyrelu",
+}
+
+
+def _map_activation(name: Optional[str]) -> str:
+    if not name:
+        return "identity"
+    return _ACTIVATIONS.get(name, name)
+
+
+class KerasModelImport:
+    """Static import entry points (parity: ``Model.importSequentialModel``)."""
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def import_sequential_model(path: str, *, train: bool = False,
+                                loss: str = "mcxent"):
+        """h5 → initialized MultiLayerNetwork with imported weights.
+
+        The final Dense layer becomes an OutputLayer with `loss` so the
+        returned net is trainable/evaluable (reference enforceTrainingConfig
+        analog)."""
+        import h5py
+        from ..nn.multilayer import MultiLayerNetwork
+
+        with h5py.File(path, "r") as f:
+            model_config = KerasModelImport._read_model_config(f)
+            class_name = model_config["class_name"]
+            if class_name != "Sequential":
+                raise ValueError(
+                    f"not a sequential model ({class_name}); use "
+                    "import_functional_model")
+            layer_configs = model_config["config"]
+            if isinstance(layer_configs, dict):  # keras 2.3+: {"layers": []}
+                layer_configs = layer_configs["layers"]
+            conf = KerasModelImport._build_sequential_conf(layer_configs, loss)
+            net = MultiLayerNetwork(conf).init()
+            KerasModelImport._load_sequential_weights(f, net, layer_configs)
+        return net
+
+    @staticmethod
+    def import_model_configuration(path_or_json: str, loss: str = "mcxent"):
+        """Config-only import: model JSON (file path or string) →
+        MultiLayerConfiguration (parity: ``ModelConfiguration``)."""
+        if path_or_json.lstrip().startswith("{"):
+            model_config = json.loads(path_or_json)
+        else:
+            with open(path_or_json) as f:
+                model_config = json.load(f)
+        layer_configs = model_config["config"]
+        if isinstance(layer_configs, dict):
+            layer_configs = layer_configs["layers"]
+        return KerasModelImport._build_sequential_conf(layer_configs, loss)
+
+    # ------------------------------------------------------------------
+    # config translation
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _read_model_config(f) -> dict:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise ValueError("no model_config attribute — architecture JSON "
+                             "required (weights-only files unsupported)")
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        return json.loads(raw)
+
+    @staticmethod
+    def _input_type_of(cfg: dict, data_format: str) -> Optional[InputType]:
+        shape = cfg.get("batch_input_shape")
+        if shape is not None:
+            shape = [s for s in shape[1:]]  # drop batch
+        elif cfg.get("input_shape") is not None:
+            shape = list(cfg["input_shape"])
+        elif cfg.get("input_dim") is not None:
+            shape = [int(cfg["input_dim"])]
+        else:
+            return None
+        if len(shape) == 1:
+            return InputType.feed_forward(int(shape[0]))
+        if len(shape) == 2:
+            return InputType.recurrent(int(shape[1]),
+                                       None if shape[0] is None else int(shape[0]))
+        if len(shape) == 3:
+            if data_format == "channels_first":
+                c, h, w = shape
+            else:
+                h, w, c = shape
+            return InputType.convolutional(int(h), int(w), int(c))
+        raise ValueError(f"unsupported input shape {shape}")
+
+    @staticmethod
+    def _data_format(cfg: dict) -> str:
+        v = cfg.get("data_format") or cfg.get("dim_ordering")
+        if v in ("channels_first", "th"):
+            return "channels_first"
+        return "channels_last"
+
+    @staticmethod
+    def _build_sequential_conf(layer_configs: List[dict], loss: str):
+        builder = NeuralNetConfiguration.builder().updater("sgd") \
+            .learning_rate(0.01).list()
+        input_type = None
+        entries = []  # (keras_name, our_layer | None)
+        last_dense_idx = -1
+        for lc in layer_configs:
+            cls = lc["class_name"]
+            cfg = lc["config"] if "config" in lc else {}
+            name = cfg.get("name") or lc.get("name") or cls.lower()
+            fmt = KerasModelImport._data_format(cfg)
+            if input_type is None:
+                it = KerasModelImport._input_type_of(cfg, fmt)
+                if it is not None:
+                    input_type = it
+            layer = KerasModelImport._translate_layer(cls, cfg, fmt)
+            if layer is None:
+                continue
+            layers_out = layer if isinstance(layer, list) else [layer]
+            for li, l in enumerate(layers_out):
+                # aux layers (e.g. LastTimeStep) carry no keras weights —
+                # suffix the name so weight lookup skips them
+                entries.append((name if li == 0 else f"{name}__aux{li}",
+                                cls if li == 0 else "_Aux", l))
+            if cls == "Dense":
+                last_dense_idx = len(entries) - 1
+        if last_dense_idx >= 0:
+            # final Dense → OutputLayer so the net can train/evaluate
+            name, cls, dense = entries[last_dense_idx]
+            is_last_param_layer = all(
+                c in ("Activation", "Dropout") for _, c, _ in
+                entries[last_dense_idx + 1:])
+            if is_last_param_layer:
+                act = dense.activation
+                # a following Activation layer overrides
+                for _, c, l in entries[last_dense_idx + 1:]:
+                    if c == "Activation":
+                        act = l.activation
+                entries = entries[:last_dense_idx + 1]
+                entries[last_dense_idx] = (name, "Dense", OutputLayer(
+                    n_in=dense.n_in, n_out=dense.n_out, activation=act,
+                    loss=loss))
+        lb = builder
+        for _, _, layer in entries:
+            lb = lb.layer(layer)
+        if input_type is not None:
+            lb = lb.set_input_type(input_type)
+        conf = lb.build()
+        conf._keras_layer_names = [n for n, _, _ in entries]
+        conf._keras_classes = [c for _, c, _ in entries]
+        return conf
+
+    @staticmethod
+    def _translate_layer(cls: str, cfg: dict, fmt: str):
+        act = _map_activation(cfg.get("activation"))
+        if cls == "Dense":
+            n_out = cfg.get("units") or cfg.get("output_dim")
+            return DenseLayer(n_out=int(n_out), activation=act)
+        if cls in ("Convolution2D", "Conv2D"):
+            n_out = cfg.get("filters") or cfg.get("nb_filter")
+            ks = cfg.get("kernel_size") or (cfg.get("nb_row"), cfg.get("nb_col"))
+            stride = cfg.get("strides") or cfg.get("subsample") or (1, 1)
+            pad = cfg.get("padding") or cfg.get("border_mode") or "valid"
+            return ConvolutionLayer(n_out=int(n_out),
+                                    kernel_size=tuple(int(k) for k in ks),
+                                    stride=tuple(int(s) for s in stride),
+                                    border_mode=str(pad), activation=act)
+        if cls in ("MaxPooling2D", "AveragePooling2D"):
+            ks = cfg.get("pool_size") or (2, 2)
+            stride = cfg.get("strides") or ks
+            pad = cfg.get("padding") or cfg.get("border_mode") or "valid"
+            return SubsamplingLayer(
+                pooling_type="max" if cls == "MaxPooling2D" else "avg",
+                kernel_size=tuple(int(k) for k in ks),
+                stride=tuple(int(s) for s in stride), border_mode=str(pad))
+        if cls == "LSTM":
+            n_out = cfg.get("units") or cfg.get("output_dim")
+            lstm = GravesLSTM(
+                n_out=int(n_out), activation=act if act != "identity" else "tanh",
+                gate_activation=_map_activation(
+                    cfg.get("recurrent_activation")
+                    or cfg.get("inner_activation") or "hard_sigmoid"))
+            if not cfg.get("return_sequences", False):
+                return [lstm, LastTimeStepLayer()]
+            return lstm
+        if cls == "Embedding":
+            return EmbeddingLayer(n_in=int(cfg["input_dim"]),
+                                  n_out=int(cfg["output_dim"]),
+                                  activation="identity", has_bias=False)
+        if cls == "BatchNormalization":
+            return BatchNormalization(eps=float(cfg.get("epsilon", 1e-5)),
+                                      decay=float(cfg.get("momentum", 0.9)))
+        if cls == "Activation":
+            return ActivationLayer(activation=act)
+        if cls == "Dropout":
+            return DropoutLayer(dropout=float(cfg.get("rate", cfg.get("p", 0.0))))
+        if cls in ("Flatten", "InputLayer"):
+            return None  # shape handling is automatic (preprocessors)
+        raise ValueError(f"unsupported Keras layer type {cls!r}")
+
+    # ------------------------------------------------------------------
+    # weight loading
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _weight_group(f):
+        return f["model_weights"] if "model_weights" in f else f
+
+    @staticmethod
+    def _layer_arrays(group, lname: str) -> Dict[str, np.ndarray]:
+        """All arrays under a keras layer group, keyed by trailing name
+        (kernel/bias/...); falls back to keras-1 style flat names."""
+        if lname not in group:
+            return {}
+        g = group[lname]
+        out = {}
+
+        def visit(name, obj):
+            import h5py
+            if isinstance(obj, h5py.Dataset):
+                key = name.split("/")[-1].split(":")[0]
+                out[key] = np.asarray(obj)
+        g.visititems(visit)
+        return out
+
+    @staticmethod
+    def _load_sequential_weights(f, net, layer_configs) -> None:
+        group = KerasModelImport._weight_group(f)
+        names = net.conf._keras_layer_names
+        classes = net.conf._keras_classes
+        import jax.numpy as jnp
+        for i, (lname, cls) in enumerate(zip(names, classes)):
+            arrays = KerasModelImport._layer_arrays(group, lname)
+            if not arrays:
+                continue
+            key = f"layer_{i}"
+            fmt = "channels_last"
+            for lc in layer_configs:
+                c = lc.get("config", {})
+                if (c.get("name") or lc.get("name")) == lname:
+                    fmt = KerasModelImport._data_format(c)
+            p = KerasModelImport._translate_weights(cls, arrays, lname, fmt)
+            if not p:
+                continue
+            cur = dict(net.params[key])
+            for pname, arr in p.items():
+                if pname in ("mean", "var"):
+                    st = dict(net.state.get(key, {}))
+                    st[pname] = jnp.asarray(arr)
+                    net.state[key] = st
+                else:
+                    if pname in cur and cur[pname].shape != arr.shape:
+                        raise ValueError(
+                            f"{lname}/{pname}: shape {arr.shape} != expected "
+                            f"{cur[pname].shape}")
+                    cur[pname] = jnp.asarray(arr)
+            net.params[key] = cur
+
+    @staticmethod
+    def _translate_weights(cls: str, arrays: Dict[str, np.ndarray],
+                           lname: str, fmt: str) -> Dict[str, np.ndarray]:
+        a = arrays
+        if cls == "Dense":
+            out = {}
+            k = a.get("kernel", a.get(f"{lname}_W"))
+            b = a.get("bias", a.get(f"{lname}_b"))
+            if k is not None:
+                out["W"] = k  # keras Dense kernel is [in, out] — ours too
+            if b is not None:
+                out["b"] = b
+            return out
+        if cls in ("Convolution2D", "Conv2D"):
+            k = a.get("kernel", a.get(f"{lname}_W"))
+            b = a.get("bias", a.get(f"{lname}_b"))
+            out = {}
+            if k is not None:
+                if k.ndim == 4 and fmt == "channels_first":
+                    k = np.transpose(k, (2, 3, 1, 0))  # OIHW → HWIO
+                out["W"] = k  # tf format already HWIO
+            if b is not None:
+                out["b"] = b
+            return out
+        if cls == "LSTM":
+            return KerasModelImport._translate_lstm(a, lname)
+        if cls == "Embedding":
+            k = a.get("embeddings", a.get(f"{lname}_W"))
+            return {} if k is None else {"W": k}
+        if cls == "BatchNormalization":
+            out = {}
+            for src, dst in (("gamma", "gamma"), ("beta", "beta"),
+                             ("moving_mean", "mean"),
+                             ("moving_variance", "var")):
+                v = a.get(src, a.get(f"{lname}_{src}"))
+                if v is not None:
+                    out[dst] = v
+            return out
+        return {}
+
+    @staticmethod
+    def _translate_lstm(a: Dict[str, np.ndarray], lname: str
+                        ) -> Dict[str, np.ndarray]:
+        """Keras LSTM → our [a|i|f|o]-concatenated layout (a = keras 'c'
+        candidate). Keras 2: kernel [in, 4H] gate order i,f,c,o. Keras 1:
+        separate W_i/U_i/b_i per gate."""
+        def reorder(k):  # [.., 4H] i,f,c,o → a,i,f,o
+            H = k.shape[-1] // 4
+            i, f, c, o = (k[..., :H], k[..., H:2 * H],
+                          k[..., 2 * H:3 * H], k[..., 3 * H:])
+            return np.concatenate([c, i, f, o], axis=-1)
+
+        if "kernel" in a:  # keras 2
+            out = {"W": reorder(a["kernel"]),
+                   "RW": reorder(a["recurrent_kernel"])}
+            if "bias" in a:
+                out["b"] = reorder(a["bias"])
+            return out
+        # keras 1: per-gate arrays
+        def get(g, kind):
+            return a.get(f"{lname}_{kind}_{g}", a.get(f"{kind}_{g}"))
+        gates = ["c", "i", "f", "o"]
+        W = np.concatenate([get(g, "W") for g in gates], axis=-1)
+        RW = np.concatenate([get(g, "U") for g in gates], axis=-1)
+        b = np.concatenate([get(g, "b") for g in gates], axis=-1)
+        return {"W": W, "RW": RW, "b": b}

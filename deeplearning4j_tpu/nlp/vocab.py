@@ -43,7 +43,9 @@ class VocabCache:
 
     def finalize(self, min_word_frequency: int = 1,
                  limit: Optional[int] = None) -> None:
-        """Drop rare words, assign indices by descending frequency."""
+        """Drop rare words, assign indices by descending frequency.
+        total_word_count shrinks to the RETAINED words' counts (word2vec
+        convention — subsampling frequencies are relative to kept words)."""
         kept = [w for w in self._words.values()
                 if w.count >= min_word_frequency]
         kept.sort(key=lambda w: (-w.count, w.word))
@@ -53,6 +55,7 @@ class VocabCache:
         self._by_index = kept
         for i, w in enumerate(kept):
             w.index = i
+        self.total_word_count = sum(w.count for w in kept)
 
     # -- lookups --
     def has_token(self, word: str) -> bool:

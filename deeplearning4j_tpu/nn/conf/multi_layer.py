@@ -19,6 +19,7 @@ from .training import TrainingConfig
 
 # ensure recurrent/pretrain layer types are registered for serde
 from . import recurrent as _recurrent  # noqa: F401
+from . import pretrain as _pretrain  # noqa: F401
 
 
 @dataclasses.dataclass

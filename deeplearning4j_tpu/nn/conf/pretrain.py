@@ -1,0 +1,156 @@
+"""Pretrain layers: denoising AutoEncoder + RBM.
+
+Parity: reference ``nn/conf/layers/AutoEncoder.java`` / ``RBM.java`` (config)
+and runtime ``nn/layers/feedforward/autoencoder/AutoEncoder.java``
+(corruption + tied-weight reconstruction) / ``rbm/RBM.java:100``
+(``contrastiveDivergence``, Gibbs chain ``:192``), plus
+``PretrainParamInitializer`` (W, hidden bias b, visible bias vb).
+
+TPU-native: the CD-k Gibbs chain is a ``lax.scan`` inside one jitted pretrain
+step; reconstruction/CD gradients come from ``jax.grad`` (for AE) or the
+explicit positive−negative phase statistics (for RBM — CD is not a true
+gradient, so it is written out, batched, as matmuls).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ... import dtypes as _dtypes
+from ..weights import init_weights
+from .inputs import InputType
+from .layers import FeedForwardLayer, register_layer
+
+
+@dataclasses.dataclass
+class BasePretrainLayer(FeedForwardLayer):
+    """Shared params: W [n_in, n_out], hidden bias b, visible bias vb
+    (parity: ``PretrainParamInitializer``)."""
+
+    loss: str = "mse"   # reconstruction loss: mse | xent
+
+    def param_shapes(self, policy=None):
+        return {"W": (self.n_in, self.n_out), "b": (self.n_out,),
+                "vb": (self.n_in,)}
+
+    def init_params(self, key, policy=None):
+        policy = policy or _dtypes.default_policy()
+        dt = policy.param_dtype
+        w = init_weights(key, (self.n_in, self.n_out),
+                         self.weight_init or "XAVIER", fan_in=self.n_in,
+                         fan_out=self.n_out, distribution=self.dist, dtype=dt)
+        return {"W": w, "b": jnp.zeros((self.n_out,), dt),
+                "vb": jnp.zeros((self.n_in,), dt)}
+
+    # encoder forward (used when stacked inside a network)
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None, policy=None):
+        policy = policy or _dtypes.default_policy()
+        x = self._dropout_in(x, train, rng)
+        xc, wc = policy.cast_to_compute(x, params["W"])
+        z = xc @ wc + params["b"].astype(xc.dtype)
+        return self._act()(z), state
+
+    def reconstruction_error(self, params, x, *, policy=None) -> jax.Array:
+        """Mean reconstruction loss on a batch (no corruption)."""
+        h, _ = self.apply(params, x, policy=policy)
+        return self._recon_loss(params, h, x)
+
+    def _decode(self, params, h):
+        return h @ params["W"].T.astype(h.dtype) + params["vb"].astype(h.dtype)
+
+    def _recon_loss(self, params, h, x):
+        z = self._decode(params, h)
+        if self.loss == "xent":
+            # sigmoid cross-entropy against inputs in [0,1], stable logit form
+            return jnp.mean(jnp.sum(
+                jnp.maximum(z, 0) - z * x + jnp.log1p(jnp.exp(-jnp.abs(z))),
+                axis=-1))
+        recon = self._act()(z) if self.loss == "mse_act" else z
+        return jnp.mean(jnp.sum((recon - x) ** 2, axis=-1))
+
+
+@register_layer("autoencoder")
+@dataclasses.dataclass
+class AutoEncoder(BasePretrainLayer):
+    """Denoising autoencoder (parity: ``AutoEncoder.java`` —
+    ``corruptionLevel`` masking noise, tied-weight decode)."""
+
+    corruption_level: float = 0.3
+
+    def pretrain_loss(self, params, x, rng, *, policy=None) -> jax.Array:
+        policy = policy or _dtypes.default_policy()
+        if self.corruption_level > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.corruption_level, x.shape)
+            x_in = x * keep.astype(x.dtype)
+        else:
+            x_in = x
+        h, _ = self.apply(params, x_in, policy=policy)
+        return self._recon_loss(params, h, x)
+
+
+@register_layer("rbm")
+@dataclasses.dataclass
+class RBM(BasePretrainLayer):
+    """Restricted Boltzmann machine (parity: ``RBM.java`` — binary/gaussian
+    units, CD-k via Gibbs chain)."""
+
+    hidden_unit: str = "binary"    # binary | rectified
+    visible_unit: str = "binary"   # binary | gaussian
+    k: int = 1                     # CD-k Gibbs steps
+
+    def prop_up(self, params, v):
+        return jax.nn.sigmoid(v @ params["W"].astype(v.dtype)
+                              + params["b"].astype(v.dtype))
+
+    def prop_down(self, params, h):
+        z = self._decode(params, h)
+        if self.visible_unit == "gaussian":
+            return z
+        return jax.nn.sigmoid(z)
+
+    def _sample_h(self, params, v, rng):
+        p = self.prop_up(params, v)
+        if self.hidden_unit == "rectified":
+            return jnp.maximum(p, 0.0), p
+        return jax.random.bernoulli(rng, p).astype(v.dtype), p
+
+    def _sample_v(self, params, h, rng):
+        p = self.prop_down(params, h)
+        if self.visible_unit == "gaussian":
+            return p + jax.random.normal(rng, p.shape, p.dtype), p
+        return jax.random.bernoulli(rng, p).astype(h.dtype), p
+
+    def contrastive_divergence_grads(self, params, v0, rng):
+        """CD-k statistics → (pseudo-)gradients for W, b, vb
+        (parity: ``RBM.contrastiveDivergence`` :100, Gibbs :192)."""
+        h0_sample, h0_prob = self._sample_h(params, v0, jax.random.fold_in(rng, 0))
+
+        def gibbs(carry, i):
+            h_sample = carry
+            v_sample, _ = self._sample_v(params, h_sample,
+                                         jax.random.fold_in(rng, 2 * i + 1))
+            h_next, h_prob = self._sample_h(params, v_sample,
+                                            jax.random.fold_in(rng, 2 * i + 2))
+            return h_next, (v_sample, h_prob)
+
+        _, (v_chain, h_chain) = jax.lax.scan(
+            gibbs, h0_sample, jnp.arange(self.k))
+        vk, hk_prob = v_chain[-1], h_chain[-1]
+        n = v0.shape[0]
+        gW = -(v0.T @ h0_prob - vk.T @ hk_prob) / n
+        gb = -jnp.mean(h0_prob - hk_prob, axis=0)
+        gvb = -jnp.mean(v0 - vk, axis=0)
+        return {"W": gW, "b": gb, "vb": gvb}
+
+    def free_energy(self, params, v) -> jax.Array:
+        """Mean free energy (monitoring; parity: RBM.freeEnergy)."""
+        wx_b = v @ params["W"].astype(v.dtype) + params["b"].astype(v.dtype)
+        vbias_term = v @ params["vb"].astype(v.dtype)
+        hidden_term = jnp.sum(jax.nn.softplus(wx_b), axis=-1)
+        return -jnp.mean(hidden_term + vbias_term)

@@ -223,3 +223,22 @@ class GravesBidirectionalLSTM(BaseRecurrentLayer):
 
     def regularized_params(self):
         return ("F_W", "F_RW", "B_W", "B_RW")
+
+
+@register_layer("last_time_step")
+@dataclasses.dataclass
+class LastTimeStepLayer(Layer):
+    """[b, t, f] → [b, f] at the last unmasked step (parity: the reference's
+    ``LastTimeStepVertex`` as a sequential layer; used by Keras import for
+    ``return_sequences=False`` recurrent layers)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.size)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None, policy=None):
+        if mask is None:
+            return x[:, -1, :], state
+        t = x.shape[1]
+        idx = t - 1 - jnp.argmax(jnp.flip(mask > 0, axis=1), axis=1)
+        return x[jnp.arange(x.shape[0]), idx], state

@@ -509,6 +509,78 @@ class MultiLayerNetwork:
             l.iteration_done(self, self.iteration_count, loss)
 
     # ------------------------------------------------------------------
+    # layerwise pretraining (parity: MultiLayerNetwork.pretrain :1052 —
+    # greedy per-layer AutoEncoder reconstruction / RBM CD-k before backprop)
+    # ------------------------------------------------------------------
+
+    def pretrain(self, data, labels=None, *, epochs: int = 1,
+                 learning_rate: Optional[float] = None) -> None:
+        """Greedy layerwise pretraining of AutoEncoder/RBM layers. Each
+        pretrainable layer trains on the previous layers' activations
+        (earlier layers frozen), then the stack moves one layer deeper."""
+        if self.params is None:
+            self.init()
+        lr = float(learning_rate if learning_rate is not None
+                   else self.training.learning_rate)
+        pre_idx = [i for i, l in enumerate(self.layers)
+                   if hasattr(l, "pretrain_loss")
+                   or hasattr(l, "contrastive_divergence_grads")]
+        if not pre_idx:
+            return
+        batches = list(self._as_batches(data, labels, None))
+        for i in pre_idx:
+            step = self._make_pretrain_step(i, lr)
+            for e in range(epochs):
+                for bi, (x, _, _) in enumerate(batches):
+                    rng = _rng.fold_name(
+                        _rng.key(self.training.seed), f"pre_{i}_{e}_{bi}")
+                    hidden = self._activation_upto(jnp.asarray(x), i)
+                    self.params[_layer_key(i)] = step(
+                        self.params[_layer_key(i)], hidden, rng)
+
+    def _activation_upto(self, x, layer_idx: int):
+        """Input activations for layer `layer_idx` (frozen earlier layers)."""
+        fn_key = f"acts_upto_{layer_idx}"
+        fn = self._jit_cache.get(fn_key)
+        if fn is None:
+            @jax.jit
+            def fn(params, states, x):
+                cur, cur_mask = x, None
+                minibatch = x.shape[0]
+                for j in range(layer_idx):
+                    proc = self.conf.input_preprocessors.get(j)
+                    if proc is not None:
+                        cur = proc(cur, minibatch_size=minibatch)
+                    cur, _ = self.layers[j].apply(
+                        params[_layer_key(j)], cur, state=states[j],
+                        train=False, policy=self.policy)
+                proc = self.conf.input_preprocessors.get(layer_idx)
+                if proc is not None:
+                    cur = proc(cur, minibatch_size=minibatch)
+                return cur
+            self._jit_cache[fn_key] = fn
+        return fn(self.params, self._states_list(), x)
+
+    def _make_pretrain_step(self, layer_idx: int, lr: float):
+        layer = self.layers[layer_idx]
+        if hasattr(layer, "contrastive_divergence_grads"):
+            @jax.jit
+            def step(lparams, v, rng):
+                grads = layer.contrastive_divergence_grads(lparams, v, rng)
+                return jax.tree_util.tree_map(
+                    lambda p, g: p - lr * g.astype(p.dtype), lparams, grads)
+            return step
+
+        @jax.jit
+        def step(lparams, x, rng):
+            grads = jax.grad(
+                lambda p: layer.pretrain_loss(p, x, rng, policy=self.policy)
+            )(lparams)
+            return jax.tree_util.tree_map(
+                lambda p, g: p - lr * g.astype(p.dtype), lparams, grads)
+        return step
+
+    # ------------------------------------------------------------------
     # evaluation bridge (full Evaluation class in eval/)
     # ------------------------------------------------------------------
 

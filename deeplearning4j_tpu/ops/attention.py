@@ -1,0 +1,132 @@
+"""Attention ops: fused single-device attention + ring attention (context
+parallelism over the ICI mesh).
+
+The reference has NO attention/sequence-parallel machinery (LSTM era — see
+SURVEY §2.9): this is the long-context north-star extension. Design follows
+the public ring-attention recipe (blockwise online-softmax accumulation while
+K/V blocks rotate around the `seq` mesh axis via ``ppermute``), so sequence
+length scales with the number of chips while every matmul stays MXU-shaped.
+
+Shapes: q/k/v are [batch, time, heads, head_dim] ("BTHD").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
+                          scale: Optional[float] = None):
+    """Standard softmax attention, single program. [b,t,h,d] → [b,t,h,d].
+
+    mask: optional [b, t_kv] key-validity mask (1=attend)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :] > 0, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _block_attend(q, k, v, m_prev, num_prev, den_prev, *, scale,
+                  q_offset, k_offset, causal):
+    """One K/V block of online-softmax accumulation (flash-style).
+
+    m/num/den carry the running max, weighted-value numerator, and
+    normalizer. q_offset/k_offset are global time offsets of the local q
+    block and current k block (for causal masking across ring hops)."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale   # [b,h,tq,tk]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qi = q_offset + jnp.arange(tq)
+        ki = k_offset + jnp.arange(tk)
+        allow = qi[:, None] >= ki[None, :]
+        logits = jnp.where(allow[None, None], logits, -jnp.inf)
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1))   # [b,h,tq]
+    # guard: rows with no allowed keys yet keep -inf max → exp(0)=1 issues;
+    # use where to keep them at zero contribution
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(logits - m_safe[..., None])                 # [b,h,tq,tk]
+    p = jnp.where(jnp.isneginf(logits), 0.0, p)
+    correction = jnp.where(jnp.isneginf(m_prev), 0.0,
+                           jnp.exp(m_prev - m_safe))
+    num = (num_prev * correction[..., None]
+           + jnp.einsum("bhqk,bkhd->bhqd", p, v))
+    den = den_prev * correction + jnp.sum(p, axis=-1)
+    return m_new, num, den
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention INSIDE a shard_map over `axis_name`.
+
+    Each device holds a [b, t_local, h, d] shard of q/k/v (the global
+    sequence is split over the mesh axis). K/V shards rotate around the ring
+    with ``ppermute`` while each device accumulates its local queries'
+    attention online — full-sequence attention without ever materializing
+    the [t, t] matrix or gathering the sequence.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    t_local = q.shape[1]
+    b, _, h, _ = q.shape
+
+    q32 = q.astype(jnp.float32)
+    # derive accumulators from q so they carry the same varying-across-mesh
+    # type as the loop body's outputs (shard_map vma consistency)
+    base = jnp.moveaxis(q32[..., 0], 1, 2)                  # [b,h,t_local]
+    m = jnp.full_like(base, -jnp.inf)
+    num = jnp.zeros_like(jnp.moveaxis(q32, 1, 2))           # [b,h,t_local,d]
+    den = jnp.zeros_like(base)
+    q_offset = idx * t_local
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        m, num, den, k_blk, v_blk = carry
+        # the block currently held came from device (idx - i) mod n
+        src = jnp.mod(idx - i, n)
+        k_offset = src * t_local
+        m, num, den = _block_attend(
+            q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+            m, num, den, scale=scale, q_offset=q_offset,
+            k_offset=k_offset, causal=causal)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return m, num, den, k_blk, v_blk
+
+    m, num, den, _, _ = jax.lax.fori_loop(0, n, body, (m, num, den, k, v))
+    out = num / jnp.maximum(den[..., None], 1e-30)          # [b,h,tq,d]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [b,tq,h,d]
+
+
+def make_ring_attention(mesh, axis_name: str = "seq", *,
+                        causal: bool = False):
+    """shard_map-wrapped ring attention: takes GLOBAL [b, t, h, d] arrays
+    sharded (or shardable) over `axis_name` on the time axis, returns the
+    global attention output with the same sharding."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return fn

@@ -1,0 +1,147 @@
+"""Tensor parallelism: parameter sharding over the ``model`` mesh axis.
+
+No reference analog (SURVEY §2.9: TP = NO) — north-star extension. Design:
+annotate parameter shardings (column-parallel weights) and let XLA GSPMD
+partition every matmul and insert the collectives; combinable with the
+``data`` axis for 2-D (dp × tp) meshes. This is the standard JAX/TPU recipe
+(scaling-book style): pick a mesh, shard the params, jit, let the compiler
+do the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import rng as _rng
+from ..optimize import updaters as _updaters
+
+Pytree = Any
+
+
+def param_partition_specs(net, model_axis: str = "model",
+                          mesh: Optional[Mesh] = None) -> Dict:
+    """PartitionSpec pytree for net.params: big weights column-parallel
+    (output dim sharded), biases sharded on their only dim, small/stat
+    params replicated. Dims not divisible by the mesh axis stay replicated."""
+    specs: Dict[str, Dict[str, P]] = {}
+    ma = model_axis
+    axis_size = mesh.shape[model_axis] if mesh is not None else 1
+
+    def _ok(dim: int) -> bool:
+        return dim % axis_size == 0
+
+    def spec_for(name: str, shape) -> P:
+        if len(shape) == 2 and _ok(shape[1]):   # dense/lstm kernels [in, out*]
+            return P(None, ma)
+        if len(shape) == 4 and _ok(shape[3]):   # conv HWIO → output channels
+            return P(None, None, None, ma)
+        if len(shape) == 1 and shape[0] > 1 and _ok(shape[0]):
+            return P(ma)             # biases / per-channel params
+        return P()
+
+    params = net.params
+    if params is None:
+        raise ValueError("net.init() first")
+    for key, layer_params in params.items():
+        specs[key] = {name: spec_for(name, p.shape)
+                      for name, p in layer_params.items()}
+    return specs
+
+
+def _shardings(tree_specs, mesh) -> Pytree:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tree_specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(net, mesh: Mesh, model_axis: str = "model") -> None:
+    """Place net.params (and updater state) according to the TP specs."""
+    specs = param_partition_specs(net, model_axis, mesh)
+    sh = _shardings(specs, mesh)
+    net.params = jax.device_put(net.params, sh)
+    if net.updater_state is not None:
+        # updater state mirrors the param tree structure per-slot; shard any
+        # leaf whose shape matches its param leaf, replicate the rest
+        flat_params = {id(l): s for l, s in zip(
+            jax.tree_util.tree_leaves(net.params),
+            jax.tree_util.tree_leaves(sh))}
+
+        def place(leaf):
+            for p, s in zip(jax.tree_util.tree_leaves(net.params),
+                            jax.tree_util.tree_leaves(sh)):
+                if hasattr(leaf, "shape") and leaf.shape == p.shape:
+                    return jax.device_put(leaf, s)
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        net.updater_state = jax.tree_util.tree_map(place, net.updater_state)
+
+
+class TensorParallelTrainer:
+    """2-D (data × model) sharded training for a MultiLayerNetwork.
+
+    Usage::
+
+        mesh = create_mesh({"data": 2, "model": 4})
+        tp = TensorParallelTrainer(net, mesh)
+        tp.fit_batch(x, y)          # params stay sharded across steps
+    """
+
+    def __init__(self, net, mesh: Mesh, data_axis: str = "data",
+                 model_axis: str = "model"):
+        if net.params is None:
+            net.init()
+        self.net = net
+        self.mesh = mesh
+        self.data_axis = data_axis if data_axis in mesh.axis_names else None
+        self.model_axis = model_axis
+        shard_params(net, mesh, model_axis)
+        self._step = self._make_step()
+
+    def _make_step(self):
+        net = self.net
+        t = net.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = net._updater
+        specs = param_partition_specs(net, self.model_axis, self.mesh)
+        param_sh = _shardings(specs, self.mesh)
+        repl = NamedSharding(self.mesh, P())
+        batch_sh = NamedSharding(
+            self.mesh,
+            P(self.data_axis) if self.data_axis else P())
+
+        def step(params, opt_state, states, x, y, mask, rng, iteration):
+            (loss, new_states), grads = jax.value_and_grad(
+                net._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, iteration)
+            params = _updaters.apply_updates(params, deltas)
+            return params, opt_state, new_states, loss
+
+        return jax.jit(
+            step, donate_argnums=(0, 1),
+            in_shardings=(param_sh, None, repl, batch_sh, batch_sh, batch_sh,
+                          repl, repl),
+            out_shardings=(param_sh, None, repl, repl))
+
+    def fit_batch(self, x, y, mask=None) -> float:
+        net = self.net
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if mask is not None:
+            mask = jnp.asarray(mask)
+        rng = _rng.fold_name(_rng.key(net.training.seed),
+                             f"update_{net._update_count}")
+        it = jnp.asarray(net._update_count, jnp.int32)
+        params, opt_state, new_states, loss = self._step(
+            net.params, net.updater_state, net._states_list(), x, y, mask,
+            rng, it)
+        net.params = params
+        net.updater_state = opt_state
+        net._update_count += 1
+        net._persist_states(new_states)
+        net._score = loss
+        net._fire_iteration(x.shape[0], loss)
+        return loss

@@ -1,0 +1,9 @@
+"""Visualization embeddings: t-SNE.
+
+Parity: reference ``plot/Tsne.java`` (exact) and ``plot/BarnesHutTsne.java``
+(θ-approximate via SpTree).
+"""
+
+from .tsne import BarnesHutTsne, Tsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

@@ -1,0 +1,159 @@
+"""UIServer: the training dashboard.
+
+Parity: reference ``deeplearning4j-play/.../PlayUIServer.java`` +
+``api/UIServer.java`` (``getInstance().attach(statsStorage)``) and the
+``TrainModule`` overview (score chart, model info, system tab) — re-done as a
+dependency-free stdlib HTTP server: JSON endpoints + one self-contained HTML
+page with inline SVG charts.
+
+Endpoints:
+  GET /                    dashboard page
+  GET /api/sessions        session ids
+  GET /api/overview?sid=   score series + timing + memory
+  GET /api/static?sid=     model/static info
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..storage.stats_storage import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+ body{font-family:sans-serif;margin:2em;background:#fafafa}
+ h1{font-size:1.3em} .card{background:#fff;border:1px solid #ddd;
+ border-radius:6px;padding:1em;margin-bottom:1em;max-width:900px}
+ svg{width:100%;height:260px} pre{white-space:pre-wrap}
+</style></head><body>
+<h1>deeplearning4j_tpu — training overview</h1>
+<div class="card"><b>Session:</b> <select id="sid"></select></div>
+<div class="card"><b>Score vs iteration</b><svg id="score"></svg></div>
+<div class="card"><b>Iteration time (ms)</b><svg id="timing"></svg></div>
+<div class="card"><b>Model</b><pre id="model"></pre></div>
+<script>
+async function j(u){return (await fetch(u)).json()}
+function line(svg, xs, ys, color){
+  const el=document.getElementById(svg); el.innerHTML='';
+  if(!xs.length) return;
+  const W=900,H=260,P=35;
+  const xmin=Math.min(...xs),xmax=Math.max(...xs)||1;
+  const ymin=Math.min(...ys),ymax=Math.max(...ys)||1;
+  const sx=x=>P+(x-xmin)/(xmax-xmin||1)*(W-2*P);
+  const sy=y=>H-P-(y-ymin)/(ymax-ymin||1)*(H-2*P);
+  let d='M'+xs.map((x,i)=>sx(x)+','+sy(ys[i])).join(' L');
+  el.innerHTML=`<path d="${d}" fill="none" stroke="${color}" stroke-width="1.5"/>
+   <text x="5" y="15" font-size="11">${ymax.toPrecision(4)}</text>
+   <text x="5" y="${H-8}" font-size="11">${ymin.toPrecision(4)}</text>`;
+}
+async function refresh(){
+  const sid=document.getElementById('sid').value;
+  if(!sid) return;
+  const o=await j('/api/overview?sid='+sid);
+  line('score', o.iterations, o.scores, '#1565c0');
+  line('timing', o.iterations.slice(1), o.timings.slice(1), '#e65100');
+  const s=await j('/api/static?sid='+sid);
+  document.getElementById('model').textContent=JSON.stringify(s,null,1);
+}
+async function init(){
+  const sessions=await j('/api/sessions');
+  const sel=document.getElementById('sid');
+  sel.innerHTML=sessions.map(s=>`<option>${s}</option>`).join('');
+  sel.onchange=refresh; refresh(); setInterval(refresh, 3000);
+}
+init();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage: StatsStorage = None
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        st = self.storage
+        if url.path == "/":
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/api/sessions":
+            self._json(st.list_session_ids())
+        elif url.path == "/api/overview":
+            sid = q.get("sid", [""])[0]
+            iters, scores, timings = [], [], []
+            for wid in st.list_workers(sid, "StatsListener"):
+                for rec in st.get_all_updates_after(sid, "StatsListener",
+                                                    wid, 0.0):
+                    iters.append(rec.data.get("iteration"))
+                    scores.append(rec.data.get("score"))
+                    timings.append(rec.data.get("iteration_ms") or 0.0)
+            self._json({"iterations": iters, "scores": scores,
+                        "timings": timings})
+        elif url.path == "/api/static":
+            sid = q.get("sid", [""])[0]
+            out = {}
+            for wid in st.list_workers(sid, "StatsListener"):
+                rec = st.get_static_info(sid, "StatsListener", wid)
+                if rec:
+                    out[wid] = {k: v for k, v in rec.data.items()
+                                if k != "config_json"}
+            self._json(out)
+        else:
+            self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """``UIServer.get_instance().attach(storage)`` then browse
+    ``http://localhost:<port>`` (parity: ``api/UIServer.java``)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.storage: Optional[StatsStorage] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        self.storage = storage
+        if self._httpd is None:
+            handler = type("BoundHandler", (_Handler,), {"storage": storage})
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.RequestHandlerClass.storage = storage
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        UIServer._instance = None

@@ -1,0 +1,138 @@
+"""StatsListener: the training-stats producer.
+
+Parity: reference ``ui/stats/StatsListener.java`` — ``iterationDone``
+(``:222``) collecting score, iteration timing, memory (``:257-298``), and
+param/gradient/update norms + histograms, posted as Persistable records to a
+StatsStorageRouter. Here device memory comes from JAX's
+``memory_stats()`` when the backend exposes it; histograms are numpy.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+from ..storage.stats_storage import Persistable, StatsStorageRouter
+
+TYPE_ID = "StatsListener"
+
+
+def _host_memory_bytes() -> Optional[int]:
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return None
+
+
+def _device_memory_stats() -> Optional[Dict[str, int]]:
+    try:
+        import jax
+        d = jax.devices()[0]
+        stats = d.memory_stats()
+        if stats:
+            return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0))}
+    except Exception:
+        pass
+    return None
+
+
+def _histogram(arr: np.ndarray, bins: int = 20) -> Dict[str, Any]:
+    counts, edges = np.histogram(arr, bins=bins)
+    return {"counts": counts.tolist(),
+            "min": float(edges[0]), "max": float(edges[-1])}
+
+
+class StatsListener(TrainingListener):
+    """Collects stats every ``frequency`` iterations and routes them to
+    storage. ``collect_histograms`` adds per-param histograms + norms
+    (off by default: it syncs params to host)."""
+
+    def __init__(self, router: StatsStorageRouter, frequency: int = 1,
+                 session_id: Optional[str] = None, worker_id: str = "worker_0",
+                 collect_histograms: bool = False,
+                 histogram_frequency: int = 10):
+        self.router = router
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"session_{uuid.uuid4().hex[:8]}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.histogram_frequency = max(1, int(histogram_frequency))
+        self._last_time: Optional[float] = None
+        self._static_posted = False
+
+    # -- listener hooks --
+    def on_epoch_start(self, model, epoch: int) -> None:
+        if not self._static_posted:
+            self._post_static(model)
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        if not self._static_posted:
+            self._post_static(model)
+        if iteration % self.frequency:
+            self._last_time = time.perf_counter()
+            return
+        now = time.perf_counter()
+        duration_ms = (None if self._last_time is None
+                       else 1000.0 * (now - self._last_time) / self.frequency)
+        self._last_time = now
+        data: Dict[str, Any] = {
+            "iteration": int(iteration),
+            "score": float(score),
+            "iteration_ms": duration_ms,
+        }
+        mem = _host_memory_bytes()
+        if mem is not None:
+            data["host_memory_bytes"] = mem
+        dev = _device_memory_stats()
+        if dev is not None:
+            data["device_memory"] = dev
+        if (self.collect_histograms
+                and (iteration // self.frequency) % self.histogram_frequency == 0):
+            data["parameters"] = self._param_stats(model)
+        self.router.put_update(Persistable(
+            session_id=self.session_id, type_id=TYPE_ID,
+            worker_id=self.worker_id, timestamp=time.time(), data=data))
+
+    # -- internals --
+    def _post_static(self, model) -> None:
+        info: Dict[str, Any] = {
+            "model_class": type(model).__name__,
+            "start_time": time.time(),
+            "pid": os.getpid(),
+        }
+        try:
+            info["num_params"] = int(model.num_params())
+            info["config_json"] = model.conf.to_json()
+        except Exception:
+            pass
+        try:
+            import jax
+            info["devices"] = [str(d) for d in jax.devices()]
+        except Exception:
+            pass
+        self.router.put_static_info(Persistable(
+            session_id=self.session_id, type_id=TYPE_ID,
+            worker_id=self.worker_id, timestamp=time.time(), data=info))
+        self._static_posted = True
+
+    def _param_stats(self, model) -> Dict[str, Any]:
+        import jax
+        out = {}
+        flat = jax.tree_util.tree_flatten_with_path(model.params)[0]
+        for path, leaf in flat:
+            name = jax.tree_util.keystr(path)
+            arr = np.asarray(leaf).ravel()
+            out[name] = {
+                "norm": float(np.linalg.norm(arr)),
+                "mean": float(arr.mean()),
+                "std": float(arr.std()),
+                "histogram": _histogram(arr),
+            }
+        return out

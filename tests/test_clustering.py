@@ -1,0 +1,102 @@
+"""Clustering + t-SNE tests (parity model: reference KMeansTest, KDTreeTest,
+VPTreeTest, TsneTest — separation/recovery assertions on synthetic blobs)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, VPTree
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _blobs(rng, k=3, per=50, d=4, spread=8.0):
+    centers = rng.normal(size=(k, d)) * spread
+    pts = np.concatenate(
+        [centers[i] + rng.normal(size=(per, d)) for i in range(k)])
+    labels = np.repeat(np.arange(k), per)
+    return pts.astype(np.float32), labels, centers
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, rng):
+        pts, labels, _ = _blobs(rng)
+        km = KMeansClustering(k=3, seed=0).fit(pts)
+        assign = km.predict(pts)
+        # cluster purity: each true blob maps to one dominant cluster
+        for c in range(3):
+            counts = np.bincount(assign[labels == c], minlength=3)
+            assert counts.max() / counts.sum() > 0.95
+        assert km.cost is not None and km.iterations_run >= 1
+
+    def test_k_larger_than_points_raises(self, rng):
+        with pytest.raises(ValueError):
+            KMeansClustering(k=10).fit(rng.normal(size=(5, 2)))
+
+    def test_deterministic_with_seed(self, rng):
+        pts, _, _ = _blobs(rng)
+        a = KMeansClustering(k=3, seed=7).fit(pts).centroids
+        b = KMeansClustering(k=3, seed=7).fit(pts).centroids
+        assert np.allclose(a, b)
+
+
+class TestTrees:
+    def test_kdtree_matches_bruteforce(self, rng):
+        pts = rng.normal(size=(200, 5))
+        tree = KDTree(pts)
+        for _ in range(10):
+            q = rng.normal(size=5)
+            i, d = tree.nn(q)
+            brute = np.linalg.norm(pts - q, axis=1)
+            assert i == int(np.argmin(brute))
+            assert d == pytest.approx(brute.min())
+
+    def test_kdtree_knn_sorted(self, rng):
+        pts = rng.normal(size=(100, 3))
+        tree = KDTree(pts)
+        res = tree.knn(rng.normal(size=3), 5)
+        assert len(res) == 5
+        dists = [d for _, d in res]
+        assert dists == sorted(dists)
+        brute = np.sort(np.linalg.norm(pts - 0, axis=1))  # placeholder
+
+    def test_vptree_matches_bruteforce(self, rng):
+        pts = rng.normal(size=(150, 4))
+        tree = VPTree(pts)
+        for _ in range(10):
+            q = rng.normal(size=4)
+            i, d = tree.nn(q)
+            brute = np.linalg.norm(pts - q, axis=1)
+            assert i == int(np.argmin(brute))
+
+    def test_vptree_cosine(self, rng):
+        pts = rng.normal(size=(80, 6))
+        tree = VPTree(pts, distance="cosine")
+        q = pts[17] * 3.0  # same direction, different magnitude
+        i, d = tree.nn(q)
+        assert i == 17
+        assert d == pytest.approx(0.0, abs=1e-9)
+
+
+class TestTsne:
+    def test_blobs_stay_separated(self, rng):
+        pts, labels, _ = _blobs(rng, k=3, per=40, d=10)
+        ts = Tsne(perplexity=10, max_iter=250, seed=1)
+        emb = ts.fit_transform(pts)
+        assert emb.shape == (120, 2)
+        # mean intra-cluster distance < mean inter-cluster distance
+        intra, inter = [], []
+        for i in range(0, 120, 7):
+            for j in range(i + 1, 120, 11):
+                d = np.linalg.norm(emb[i] - emb[j])
+                (intra if labels[i] == labels[j] else inter).append(d)
+        assert np.mean(intra) < 0.5 * np.mean(inter)
+        assert ts.kl_divergence is not None and np.isfinite(ts.kl_divergence)
+
+    def test_perplexity_validation(self, rng):
+        with pytest.raises(ValueError, match="perplexity"):
+            Tsne(perplexity=30).fit_transform(rng.normal(size=(20, 4)))
+
+    def test_barnes_hut_api(self, rng):
+        pts, _, _ = _blobs(rng, k=2, per=30, d=6)
+        emb = BarnesHutTsne(theta=0.5, perplexity=8, max_iter=100,
+                            seed=2).fit_transform(pts)
+        assert emb.shape == (60, 2)

@@ -1,0 +1,173 @@
+"""Keras import tests (parity model: reference LayerBuildTest /
+ModelConfigurationTest — config parsing against checked-in Keras configs —
+plus weight-loading verified numerically against a numpy reference forward).
+
+Fixtures are hand-built h5 files in the exact Keras save format (keras isn't
+installed in this image), which doubles as a format-spec test.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import KerasModelImport
+
+h5py = pytest.importorskip("h5py")
+
+
+def _keras2_sequential_mlp(path, rng):
+    """Keras-2-style: Dense(8, relu) -> Dense(3, softmax), input_dim=5."""
+    W1 = rng.normal(size=(5, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    W2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    config = {
+        "class_name": "Sequential",
+        "config": {"name": "seq", "layers": [
+            {"class_name": "Dense", "config": {
+                "name": "dense_1", "units": 8, "activation": "relu",
+                "batch_input_shape": [None, 5]}},
+            {"class_name": "Dense", "config": {
+                "name": "dense_2", "units": 3, "activation": "softmax"}},
+        ]},
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(config).encode()
+        mw = f.create_group("model_weights")
+        g1 = mw.create_group("dense_1")
+        g1.create_dataset("dense_1/kernel:0", data=W1)
+        g1.create_dataset("dense_1/bias:0", data=b1)
+        g2 = mw.create_group("dense_2")
+        g2.create_dataset("dense_2/kernel:0", data=W2)
+        g2.create_dataset("dense_2/bias:0", data=b2)
+    return (W1, b1, W2, b2)
+
+
+class TestSequentialImport:
+    def test_mlp_forward_matches_numpy(self, rng, tmp_path):
+        p = str(tmp_path / "mlp.h5")
+        W1, b1, W2, b2 = _keras2_sequential_mlp(p, rng)
+        net = KerasModelImport.import_sequential_model(p)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        h = np.maximum(x @ W1 + b1, 0)
+        logits = h @ W2 + b2
+        ref = np.exp(logits - logits.max(axis=1, keepdims=True))
+        ref /= ref.sum(axis=1, keepdims=True)
+        assert out.shape == (4, 3)
+        assert np.allclose(out, ref, atol=1e-5), np.abs(out - ref).max()
+
+    def test_imported_net_is_trainable(self, rng, tmp_path):
+        p = str(tmp_path / "mlp2.h5")
+        _keras2_sequential_mlp(p, rng)
+        net = KerasModelImport.import_sequential_model(p)
+        x = rng.normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        s0 = net.score_for(x, y)
+        for _ in range(5):
+            net.fit_batch(x, y)
+        assert net.score() < s0 * 2  # trains without error
+
+    def test_conv_model(self, rng, tmp_path):
+        """Conv2D(tf format) -> MaxPool -> Flatten -> Dense(softmax)."""
+        p = str(tmp_path / "cnn.h5")
+        K = rng.normal(size=(3, 3, 1, 4)).astype(np.float32)  # HWIO
+        bk = np.zeros(4, np.float32)
+        Wd = rng.normal(size=(4 * 3 * 3, 2)).astype(np.float32)
+        bd = np.zeros(2, np.float32)
+        config = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Conv2D", "config": {
+                    "name": "conv", "filters": 4, "kernel_size": [3, 3],
+                    "strides": [1, 1], "padding": "valid",
+                    "activation": "relu", "data_format": "channels_last",
+                    "batch_input_shape": [None, 8, 8, 1]}},
+                {"class_name": "MaxPooling2D", "config": {
+                    "name": "pool", "pool_size": [2, 2], "strides": [2, 2],
+                    "padding": "valid"}},
+                {"class_name": "Flatten", "config": {"name": "flat"}},
+                {"class_name": "Dense", "config": {
+                    "name": "out", "units": 2, "activation": "softmax"}},
+            ],
+        }
+        with h5py.File(p, "w") as f:
+            f.attrs["model_config"] = json.dumps(config).encode()
+            mw = f.create_group("model_weights")
+            g = mw.create_group("conv")
+            g.create_dataset("conv/kernel:0", data=K)
+            g.create_dataset("conv/bias:0", data=bk)
+            g = mw.create_group("out")
+            g.create_dataset("out/kernel:0", data=Wd)
+            g.create_dataset("out/bias:0", data=bd)
+        net = KerasModelImport.import_sequential_model(p)
+        x = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 2)
+        assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_lstm_keras2_gate_reorder(self, rng, tmp_path):
+        p = str(tmp_path / "lstm.h5")
+        H, F = 6, 4
+        kernel = rng.normal(size=(F, 4 * H)).astype(np.float32)      # i,f,c,o
+        rec = rng.normal(size=(H, 4 * H)).astype(np.float32)
+        bias = rng.normal(size=(4 * H,)).astype(np.float32)
+        Wd = rng.normal(size=(H, 2)).astype(np.float32)
+        config = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "LSTM", "config": {
+                    "name": "lstm", "units": H, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "batch_input_shape": [None, 5, F]}},
+                {"class_name": "Dense", "config": {
+                    "name": "out", "units": 2, "activation": "softmax"}},
+            ],
+        }
+        with h5py.File(p, "w") as f:
+            f.attrs["model_config"] = json.dumps(config).encode()
+            mw = f.create_group("model_weights")
+            g = mw.create_group("lstm")
+            g.create_dataset("lstm/kernel:0", data=kernel)
+            g.create_dataset("lstm/recurrent_kernel:0", data=rec)
+            g.create_dataset("lstm/bias:0", data=bias)
+            g = mw.create_group("out")
+            g.create_dataset("out/kernel:0", data=Wd)
+            g.create_dataset("out/bias:0", data=np.zeros(2, np.float32))
+        net = KerasModelImport.import_sequential_model(p)
+        # gate reorder: our W columns [a|i|f|o] == keras [c|i|f|o]
+        W = np.asarray(net.params["layer_0"]["W"])
+        assert np.allclose(W[:, :H], kernel[:, 2 * H:3 * H])   # a == c
+        assert np.allclose(W[:, H:2 * H], kernel[:, :H])       # i
+        # forward runs: [b,t,f] -> GlobalPooled? no: rnn->ff preproc takes
+        # last step; just check output shape
+        x = rng.normal(size=(3, 5, F)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape[0] == 3
+
+    def test_config_only_import(self):
+        config = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Dense", "config": {
+                    "name": "d", "units": 4, "activation": "tanh",
+                    "batch_input_shape": [None, 7]}},
+                {"class_name": "Dropout", "config": {"name": "dr", "rate": 0.5}},
+                {"class_name": "Dense", "config": {
+                    "name": "o", "units": 2, "activation": "softmax"}},
+            ],
+        }
+        conf = KerasModelImport.import_model_configuration(json.dumps(config))
+        assert conf.layers[0].n_in == 7
+        assert conf.layers[0].n_out == 4
+        assert conf.layers[1].dropout == 0.5
+        # final dense became a trainable OutputLayer
+        from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+        assert isinstance(conf.layers[-1], OutputLayer)
+
+    def test_unsupported_layer_raises(self):
+        config = {"class_name": "Sequential", "config": [
+            {"class_name": "Lambda", "config": {"name": "l"}}]}
+        with pytest.raises(ValueError, match="unsupported"):
+            KerasModelImport.import_model_configuration(json.dumps(config))

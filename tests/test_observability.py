@@ -1,0 +1,118 @@
+"""Observability tests (parity model: reference TestPlayUI — boot server,
+attach InMemoryStatsStorage, train a small net, HTTP assertions; plus storage
+contract tests)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.storage import (FileStatsStorage, InMemoryStatsStorage,
+                                        Persistable)
+from deeplearning4j_tpu.ui import StatsListener, UIServer
+
+
+def _train_with_listener(rng, storage, iterations=8, **listener_kw):
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("adam")
+            .learning_rate(0.01).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    net = MultiLayerNetwork(conf).init()
+    listener = StatsListener(storage, session_id="test_session", **listener_kw)
+    net.set_listeners(listener)
+    x = rng.normal(size=(16, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    for _ in range(iterations):
+        net.fit_batch(x, y)
+    return net
+
+
+class TestStatsStorage:
+    def test_static_and_updates(self):
+        st = InMemoryStatsStorage()
+        st.put_static_info(Persistable("s1", "T", "w0", 1.0, {"a": 1}))
+        st.put_update(Persistable("s1", "T", "w0", 2.0, {"x": 1}))
+        st.put_update(Persistable("s1", "T", "w0", 3.0, {"x": 2}))
+        assert st.list_session_ids() == ["s1"]
+        assert st.list_type_ids("s1") == ["T"]
+        assert st.list_workers("s1", "T") == ["w0"]
+        assert st.get_static_info("s1", "T", "w0").data == {"a": 1}
+        assert len(st.get_all_updates_after("s1", "T", "w0", 2.0)) == 1
+        assert st.get_latest_update("s1", "T", "w0").data == {"x": 2}
+
+    def test_listener_notified(self):
+        st = InMemoryStatsStorage()
+        events = []
+
+        class L:
+            def notify(self, event, record):
+                events.append((event, record.session_id))
+        st.register_listener(L())
+        st.put_update(Persistable("s", "T", "w", 1.0, {}))
+        assert events == [("update", "s")]
+
+    def test_file_storage_reload(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        st = FileStatsStorage(p)
+        st.put_static_info(Persistable("s1", "T", "w0", 1.0, {"a": 1}))
+        st.put_update(Persistable("s1", "T", "w0", 2.0, {"score": 0.5}))
+        st.close()
+        st2 = FileStatsStorage(p)
+        assert st2.list_session_ids() == ["s1"]
+        assert st2.get_latest_update("s1", "T", "w0").data == {"score": 0.5}
+        st2.close()
+
+
+class TestStatsListener:
+    def test_collects_scores_and_static(self, rng):
+        st = InMemoryStatsStorage()
+        _train_with_listener(rng, st, iterations=6)
+        updates = st.get_all_updates_after("test_session", "StatsListener",
+                                           "worker_0", 0.0)
+        assert len(updates) == 6
+        assert all(np.isfinite(u.data["score"]) for u in updates)
+        static = st.get_static_info("test_session", "StatsListener", "worker_0")
+        assert static.data["model_class"] == "MultiLayerNetwork"
+        assert static.data["num_params"] > 0
+
+    def test_frequency_and_histograms(self, rng):
+        st = InMemoryStatsStorage()
+        _train_with_listener(rng, st, iterations=8, frequency=2,
+                             collect_histograms=True, histogram_frequency=1)
+        updates = st.get_all_updates_after("test_session", "StatsListener",
+                                           "worker_0", 0.0)
+        assert len(updates) == 4  # every 2nd iteration
+        p = updates[0].data["parameters"]
+        assert any("W" in k for k in p)
+        first = next(iter(p.values()))
+        assert "norm" in first and "histogram" in first
+
+
+class TestUIServer:
+    def test_endpoints(self, rng):
+        st = InMemoryStatsStorage()
+        _train_with_listener(rng, st, iterations=5)
+        server = UIServer(port=0).attach(st)  # port 0 → ephemeral
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            sessions = json.loads(urllib.request.urlopen(
+                base + "/api/sessions", timeout=5).read())
+            assert sessions == ["test_session"]
+            overview = json.loads(urllib.request.urlopen(
+                base + "/api/overview?sid=test_session", timeout=5).read())
+            assert len(overview["scores"]) == 5
+            assert overview["iterations"] == [1, 2, 3, 4, 5]
+            page = urllib.request.urlopen(base + "/", timeout=5).read()
+            assert b"training overview" in page
+            static = json.loads(urllib.request.urlopen(
+                base + "/api/static?sid=test_session", timeout=5).read())
+            assert static["worker_0"]["model_class"] == "MultiLayerNetwork"
+        finally:
+            server.stop()

@@ -1,0 +1,122 @@
+"""AutoEncoder/RBM pretraining tests (parity model: reference
+AutoEncoderTest / RBMTests — reconstruction error decreases under pretraining;
+CD statistics shapes; stacked pretrain then fine-tune)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.pretrain import RBM, AutoEncoder
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu import rng as _rng
+
+
+def _structured_data(rng, n=64, d=12):
+    """Low-rank structured inputs (reconstructable)."""
+    basis = rng.normal(size=(3, d))
+    codes = rng.normal(size=(n, 3))
+    x = codes @ basis + 0.05 * rng.normal(size=(n, d))
+    return ((x - x.min()) / (x.max() - x.min())).astype(np.float32)
+
+
+class TestAutoEncoder:
+    def test_layer_forward(self, rng):
+        ae = AutoEncoder(n_in=12, n_out=6, activation="sigmoid",
+                         weight_init="XAVIER")
+        params = ae.init_params(_rng.key(0))
+        x = jnp.asarray(rng.normal(size=(4, 12)).astype(np.float32))
+        h, _ = ae.apply(params, x)
+        assert h.shape == (4, 6)
+        assert set(params) == {"W", "b", "vb"}
+
+    def test_pretrain_reduces_reconstruction_error(self, rng):
+        x = _structured_data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater("sgd").learning_rate(0.5).list()
+                .layer(AutoEncoder(n_out=6, activation="sigmoid",
+                                   corruption_level=0.2, loss="mse"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        ae = net.layers[0]
+        e0 = float(ae.reconstruction_error(net.params["layer_0"],
+                                           jnp.asarray(x)))
+        net.pretrain((x, np.zeros((64, 3), np.float32)), epochs=60)
+        e1 = float(ae.reconstruction_error(net.params["layer_0"],
+                                           jnp.asarray(x)))
+        assert e1 < e0 * 0.7, (e0, e1)
+
+    def test_pretrain_then_finetune(self, rng):
+        x = _structured_data(rng)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        conf = (NeuralNetConfiguration.builder().seed(6)
+                .updater("adam").learning_rate(0.01).list()
+                .layer(AutoEncoder(n_out=8, activation="sigmoid"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.pretrain((x, y), epochs=20)
+        s0 = net.score_for(x, y)
+        for _ in range(30):
+            net.fit_batch(x, y)
+        assert net.score() < s0
+
+    def test_serde(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(AutoEncoder(n_out=6, corruption_level=0.4))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(10)).build())
+        from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert isinstance(back.layers[0], AutoEncoder)
+        assert back.layers[0].corruption_level == 0.4
+
+
+class TestRBM:
+    def test_cd_shapes_and_energy_decreases(self, rng):
+        x = (_structured_data(rng) > 0.5).astype(np.float32)  # binary visibles
+        rbm = RBM(n_in=12, n_out=6, activation="sigmoid",
+                  weight_init="XAVIER", k=1)
+        params = rbm.init_params(_rng.key(1))
+        xj = jnp.asarray(x)
+        e0 = float(rbm.free_energy(params, xj))
+        key = _rng.key(2)
+        for i in range(80):
+            grads = rbm.contrastive_divergence_grads(
+                params, xj, jax.random.fold_in(key, i))
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - 0.1 * g.astype(p.dtype), params, grads)
+        e1 = float(rbm.free_energy(params, xj))
+        # training lowers free energy of the data
+        assert e1 < e0, (e0, e1)
+
+    def test_rbm_in_network_pretrain(self, rng):
+        x = (_structured_data(rng) > 0.5).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater("sgd").learning_rate(0.1).list()
+                .layer(RBM(n_out=6, activation="sigmoid", k=2))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        p_before = np.asarray(net.params["layer_0"]["W"]).copy()
+        net.pretrain((x, y), epochs=5)
+        p_after = np.asarray(net.params["layer_0"]["W"])
+        assert not np.allclose(p_before, p_after)  # CD updated the weights
+        net.fit_batch(x, y)  # fine-tune path still works
+
+    def test_gaussian_visible(self, rng):
+        rbm = RBM(n_in=8, n_out=4, activation="sigmoid",
+                  weight_init="XAVIER", visible_unit="gaussian")
+        params = rbm.init_params(_rng.key(3))
+        v = jnp.asarray(rng.normal(size=(4, 8)).astype(np.float32))
+        grads = rbm.contrastive_divergence_grads(params, v, _rng.key(4))
+        assert grads["W"].shape == (8, 4)
+        assert np.all(np.isfinite(np.asarray(grads["W"])))

@@ -1,0 +1,114 @@
+"""Tensor-parallel + ring-attention tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.attention import (
+    dot_product_attention, make_ring_attention)
+from deeplearning4j_tpu.parallel import create_mesh
+from deeplearning4j_tpu.parallel.tensor import (
+    TensorParallelTrainer, param_partition_specs, shard_params)
+
+
+def _conf(seed=42):
+    return (NeuralNetConfiguration.builder().seed(seed).updater("sgd")
+            .learning_rate(0.1).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+
+
+def _data(rng, n=32):
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4))
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+class TestTensorParallel:
+    def test_partition_specs_shapes(self):
+        net = MultiLayerNetwork(_conf()).init()
+        specs = param_partition_specs(net)
+        from jax.sharding import PartitionSpec as P
+        assert specs["layer_0"]["W"] == P(None, "model")
+        assert specs["layer_0"]["b"] == P("model")
+
+    def test_tp_matches_single_device(self, rng):
+        x, y = _data(rng)
+        ref = MultiLayerNetwork(_conf()).init()
+        for _ in range(5):
+            ref.fit_batch(x, y)
+
+        mesh = create_mesh({"model": 8})
+        net = MultiLayerNetwork(_conf()).init()
+        tp = TensorParallelTrainer(net, mesh, data_axis=None)
+        for _ in range(5):
+            tp.fit_batch(x, y)
+        for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                        jax.tree_util.tree_leaves(net.params)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4), \
+                "tensor-parallel training diverged from single-device"
+
+    def test_2d_mesh_dp_tp(self, rng):
+        x, y = _data(rng)
+        mesh = create_mesh({"data": 2, "model": 4})
+        net = MultiLayerNetwork(_conf()).init()
+        tp = TensorParallelTrainer(net, mesh)
+        s0 = float(net.score_for(x, y))
+        for _ in range(20):
+            tp.fit_batch(x, y)
+        assert float(net.score_for(x, y)) < s0 * 0.8
+
+    def test_params_actually_sharded(self):
+        mesh = create_mesh({"model": 8})
+        net = MultiLayerNetwork(_conf()).init()
+        shard_params(net, mesh)
+        w = net.params["layer_0"]["W"]
+        assert len(w.sharding.device_set) == 8
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_attention(self, rng, causal):
+        b, t, h, d = 2, 32, 4, 16   # t divisible by 8 devices
+        q = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        k = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        v = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        ref = np.asarray(dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+
+        mesh = create_mesh({"seq": 8})
+        ring = make_ring_attention(mesh, "seq", causal=causal)
+        out = np.asarray(jax.jit(ring)(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v)))
+        assert out.shape == ref.shape
+        assert np.allclose(out, ref, atol=2e-5), \
+            f"max err {np.abs(out - ref).max()}"
+
+    def test_long_sequence_runs(self, rng):
+        """Sequence length 512 over 8 shards — never materializes [t, t]."""
+        b, t, h, d = 1, 512, 2, 8
+        q = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        mesh = create_mesh({"seq": 8})
+        ring = make_ring_attention(mesh, "seq", causal=True)
+        out = np.asarray(jax.jit(ring)(jnp.asarray(q), jnp.asarray(q),
+                                       jnp.asarray(q)))
+        assert out.shape == (b, t, h, d)
+        assert np.all(np.isfinite(out))
+
+    def test_dense_attention_mask(self, rng):
+        b, t, h, d = 2, 8, 2, 4
+        q = jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+        mask = np.ones((b, t), np.float32)
+        mask[:, 6:] = 0
+        out = dot_product_attention(q, q, q, mask=jnp.asarray(mask))
+        # masked keys contribute nothing: recompute with truncated k/v
+        out_trunc = dot_product_attention(q, q[:, :6], q[:, :6])
+        assert np.allclose(np.asarray(out), np.asarray(out_trunc), atol=1e-5)
