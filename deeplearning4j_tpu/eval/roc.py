@@ -34,8 +34,17 @@ class ROC:
         predictions = np.asarray(predictions)
         if labels.ndim == 2 and labels.shape[1] == 2:
             labels = labels[:, 1]
+        if predictions.ndim == 2 and predictions.shape[1] == 2:
+            # two-column probabilities with single-column labels: column 1 is
+            # the positive class (reference convention)
             predictions = predictions[:, 1]
-        return labels.reshape(-1), predictions.reshape(-1)
+        y, p = labels.reshape(-1), predictions.reshape(-1)
+        if y.shape[0] != p.shape[0]:
+            raise ValueError(
+                f"ROC.eval: {y.shape[0]} labels vs {p.shape[0]} predictions "
+                "after flattening — shapes must describe the same examples "
+                f"(labels {labels.shape}, predictions {predictions.shape})")
+        return y, p
 
     def eval(self, labels, predictions, mask=None) -> None:
         y, p = self._binary_views(labels, predictions)

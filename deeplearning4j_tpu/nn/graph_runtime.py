@@ -202,6 +202,10 @@ class ComputationGraph:
                 masks if masks is not None else [None] * len(inputs)))
         new_states: Dict[str, Dict[str, jax.Array]] = {}
         label_map = dict(zip(self.conf.network_outputs, labels))
+        # output-layer vertices that also feed downstream vertices must still
+        # publish their activation (reference ComputationGraph supports output
+        # layers with consumers); XLA CSE merges the duplicated layer forward
+        consumed = {i for ins in self.conf.vertex_inputs.values() for i in ins}
         total = 0.0
         denom_total = 0.0
         for name in self.topo_order:
@@ -225,7 +229,16 @@ class ComputationGraph:
                 denom = _losses.masked_denominator(out_mask, y,
                                                   score_arr.shape[0])
                 total = total + jnp.sum(score_arr) / denom
-                new_states[name] = {}
+                if name in consumed:
+                    out, st = v.apply(params[name], xs, state=states[name],
+                                      train=True, rng=vrng, masks=in_masks,
+                                      policy=self.policy)
+                    acts[name] = out
+                    mask_map[name] = v.output_mask(
+                        in_masks, minibatch=xs[0].shape[0])
+                    new_states[name] = st if st is not None else {}
+                else:
+                    new_states[name] = {}
             else:
                 out, st = v.apply(params[name], xs, state=states[name],
                                   train=True, rng=vrng, masks=in_masks,
@@ -311,6 +324,13 @@ class ComputationGraph:
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
 
+    def _fire_iteration(self, batch_size, loss):
+        self.iteration_count += 1
+        for l in self.listeners:
+            if hasattr(l, "record_batch"):
+                l.record_batch(batch_size)
+            l.iteration_done(self, self.iteration_count, loss)
+
     def _make_train_scan(self):
         """K train steps fused into ONE lax.scan XLA program (same design as
         MultiLayerNetwork._make_train_scan)."""
@@ -365,9 +385,15 @@ class ComputationGraph:
         self._update_count += k
         self._persist_states(new_states)
         self._score = losses[-1]
-        self.iteration_count += k
-        for l in self.listeners:
-            l.iteration_done(self, self.iteration_count, losses[-1])
+        # replay per-step losses so listener/stats semantics (score history,
+        # throughput via record_batch) match fit()/fit_batch for k updates
+        if self.listeners:
+            batch_size = int(xs[0].shape[1])
+            per_step = np.asarray(losses)
+            for i in range(k):
+                self._fire_iteration(batch_size, per_step[i])
+        else:
+            self.iteration_count += k
         return losses
 
     def fit_batch(self, inputs, labels, masks=None):

@@ -394,9 +394,15 @@ class MultiLayerNetwork:
         self._update_count += k
         self._persist_states(new_states)
         self._score = losses[-1]
-        self.iteration_count += k
-        for l in self.listeners:
-            l.iteration_done(self, self.iteration_count, losses[-1])
+        # replay per-step losses so listener/stats semantics (score history,
+        # throughput via record_batch) match fit()/fit_batch for k updates
+        if self.listeners:
+            batch_size = int(xs.shape[1])
+            per_step = np.asarray(losses)
+            for i in range(k):
+                self._fire_iteration(batch_size, per_step[i])
+        else:
+            self.iteration_count += k
         return losses
 
     # ------------------------------------------------------------------

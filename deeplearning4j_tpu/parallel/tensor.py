@@ -63,20 +63,18 @@ def shard_params(net, mesh: Mesh, model_axis: str = "model") -> None:
     specs = param_partition_specs(net, model_axis, mesh)
     sh = _shardings(specs, mesh)
     net.params = jax.device_put(net.params, sh)
-    if net.updater_state is not None:
-        # updater state mirrors the param tree structure per-slot; shard any
-        # leaf whose shape matches its param leaf, replicate the rest
-        flat_params = {id(l): s for l, s in zip(
-            jax.tree_util.tree_leaves(net.params),
-            jax.tree_util.tree_leaves(sh))}
-
-        def place(leaf):
-            for p, s in zip(jax.tree_util.tree_leaves(net.params),
-                            jax.tree_util.tree_leaves(sh)):
-                if hasattr(leaf, "shape") and leaf.shape == p.shape:
-                    return jax.device_put(leaf, s)
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-        net.updater_state = jax.tree_util.tree_map(place, net.updater_state)
+    if net.updater_state:
+        # updater state is {slot_name: params-like tree} (see updaters.py
+        # init functions), so each slot takes the param shardings structurally
+        placed = {}
+        for slot_name, slot in net.updater_state.items():
+            try:
+                placed[slot_name] = jax.device_put(slot, sh)
+            except ValueError:
+                # slot does not mirror the param tree: replicate it
+                placed[slot_name] = jax.device_put(
+                    slot, NamedSharding(mesh, P()))
+        net.updater_state = placed
 
 
 class TensorParallelTrainer:

@@ -30,6 +30,38 @@ def _tree_map(f, *trees):
     return jax.tree_util.tree_map(f, *trees, is_leaf=lambda x: x is None)
 
 
+def _is_graph(net) -> bool:
+    return hasattr(net, "topo_order")
+
+
+def _net_states(net):
+    """states in whatever structure the net's _loss_fn expects."""
+    return net._states_map() if _is_graph(net) else net._states_list()
+
+
+def _batchify(net, x, y, mask):
+    """Convert a batch to the form the net's _loss_fn expects: arrays for
+    MultiLayerNetwork, lists of arrays for ComputationGraph (multi-in/out)."""
+    def conv(v):
+        if v is None:
+            return None
+        if isinstance(v, (list, tuple)):
+            return [None if a is None else jnp.asarray(a) for a in v]
+        return jnp.asarray(v)
+    x, y, mask = conv(x), conv(y), conv(mask)
+    if _is_graph(net):
+        x = x if isinstance(x, list) else [x]
+        y = y if isinstance(y, list) else [y]
+        if mask is not None and not isinstance(mask, list):
+            mask = [mask]
+    return x, y, mask
+
+
+def _batch_dim(x) -> int:
+    leaf = x[0] if isinstance(x, (list, tuple)) else x
+    return int(leaf.shape[0])
+
+
 class ParallelWrapper:
     """Wrap an (initialized) network for data-parallel training.
 
@@ -83,11 +115,25 @@ class ParallelWrapper:
             params = _updaters.apply_updates(params, deltas)
             return params, opt_state, new_states, loss
 
-        return jax.jit(
+        jitted = jax.jit(
             step,
             donate_argnums=(0, 1),
             in_shardings=(repl, repl, repl, bsh, bsh, bsh, repl, repl),
             out_shardings=(repl, repl, repl, repl))
+
+        n = self.n_devices
+
+        def checked(params, opt_state, states, x, y, mask, rng, iteration):
+            bs = _batch_dim(x)
+            if bs % n:
+                raise ValueError(
+                    f"batch size {bs} not divisible by the {n}-device "
+                    "'data' mesh axis (sync SPMD mode shards the batch "
+                    "evenly across devices)")
+            return jitted(params, opt_state, states, x, y, mask, rng,
+                          iteration)
+
+        return checked
 
     # ------------------------------------------------------------------
     # local-SGD mode: stacked replicas via shard_map + periodic averaging
@@ -103,9 +149,15 @@ class ParallelWrapper:
     # ------------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1, mask=None) -> None:
-        self._check_batch_divisibility_hint()
         if self.averaging_frequency == 1:
-            self.net.fit(data, labels, epochs=epochs, mask=mask)
+            if _is_graph(self.net):
+                if mask is not None:
+                    raise ValueError(
+                        "ComputationGraph: pass masks via DataSet batches, "
+                        "not the mask kwarg")
+                self.net.fit(data, labels, epochs=epochs)
+            else:
+                self.net.fit(data, labels, epochs=epochs, mask=mask)
             return
         local = self._ensure_local()
         net = self.net
@@ -122,15 +174,23 @@ class ParallelWrapper:
         local.sync_to_net()
 
     def fit_batch(self, x, y, mask=None) -> float:
+        """One update. In local-SGD mode replicas step independently and the
+        average happens only every ``averaging_frequency`` calls (matching the
+        reference's semantics); the wrapped net's params are refreshed at each
+        averaging point — call :meth:`finish` (or ``average_now``) after the
+        last batch to flush a partial window."""
         if self.averaging_frequency == 1:
             return self.net.fit_batch(x, y, mask)
         local = self._ensure_local()
         loss = local.fit_batch(x, y, mask)
-        local.sync_to_net()
+        if local._steps_since_avg == 0:  # an average just ran: publish it
+            local.sync_to_net()
         return loss
 
-    def _check_batch_divisibility_hint(self) -> None:
-        pass  # checked per batch where the shapes are known
+    def finish(self) -> None:
+        """Flush local-SGD replicas into the wrapped net (average + sync)."""
+        if self._local is not None:
+            self._local.sync_to_net()
 
     def average_now(self) -> None:
         """Force a parameter average (local-SGD mode)."""
@@ -154,7 +214,7 @@ class _LocalSgdState:
         dev_sh = NamedSharding(self.mesh, P("data"))
         self.params = jax.device_put(_tree_map(stack, net.params), dev_sh)
         self.opt_state = jax.device_put(_tree_map(stack, net.updater_state), dev_sh)
-        self.states = jax.device_put(_tree_map(stack, net._states_list()), dev_sh)
+        self.states = jax.device_put(_tree_map(stack, _net_states(net)), dev_sh)
         self._step = self._make_step()
         self._avg = self._make_avg()
 
@@ -205,13 +265,12 @@ class _LocalSgdState:
 
     def fit_batch(self, x, y, mask=None) -> float:
         net = self.net
-        x, y = jnp.asarray(x), jnp.asarray(y)
-        if x.shape[0] % self.n:
+        x, y, mask = _batchify(net, x, y, mask)
+        bs = _batch_dim(x)
+        if bs % self.n:
             raise ValueError(
-                f"batch size {x.shape[0]} not divisible by the {self.n}-device "
+                f"batch size {bs} not divisible by the {self.n}-device "
                 "data axis")
-        if mask is not None:
-            mask = jnp.asarray(mask)
         rng = _rng.fold_name(_rng.key(net.training.seed),
                              f"update_{net._update_count}")
         it = jnp.asarray(net._update_count, jnp.int32)
@@ -223,7 +282,7 @@ class _LocalSgdState:
             self.average()
         score = jnp.mean(loss)  # stays on device; score() syncs lazily
         net._score = score
-        net._fire_iteration(x.shape[0], score)
+        net._fire_iteration(bs, score)
         return score
 
     def average(self) -> None:

@@ -28,7 +28,35 @@ Pytree = Any
 _CONFIG_ENTRY = "configuration.json"
 _ARRAYS_ENTRY = "arrays.npz"
 _STATE_ENTRY = "training_state.json"
+_DTYPES_ENTRY = "dtypes.json"
 _FORMAT_VERSION = 1
+
+
+def _npz_safe(arrays: Dict[str, np.ndarray]) -> Tuple[Dict[str, np.ndarray],
+                                                      Dict[str, str]]:
+    """np.savez silently stores extension dtypes (ml_dtypes bfloat16 etc.) as
+    raw void bytes; cast them to float32 for storage and record the original
+    dtype name in a sidecar so the round-trip preserves dtype."""
+    safe, dtype_map = {}, {}
+    for k, a in arrays.items():
+        if a.dtype.kind == "V":  # ml_dtypes extension types report kind 'V'
+            dtype_map[k] = a.dtype.name
+            safe[k] = a.astype(np.float32)
+        else:
+            safe[k] = a
+    return safe, dtype_map
+
+
+def _restore_dtypes(arrays: Dict[str, np.ndarray],
+                    dtype_map: Dict[str, str]) -> Dict[str, np.ndarray]:
+    if not dtype_map:
+        return arrays
+    import ml_dtypes
+    out = dict(arrays)
+    for k, name in dtype_map.items():
+        if k in out:
+            out[k] = out[k].astype(np.dtype(getattr(ml_dtypes, name)))
+    return out
 
 
 def _flatten(prefix: str, tree: Pytree, out: Dict[str, np.ndarray]) -> None:
@@ -88,6 +116,7 @@ class ModelSerializer:
         _flatten("state", jax.device_get(net.state), arrays)
         if save_updater and net.updater_state is not None:
             _flatten("updater", jax.device_get(net.updater_state), arrays)
+        arrays, dtype_map = _npz_safe(arrays)
         buf = io.BytesIO()
         np.savez(buf, **arrays)
         training_state = {
@@ -102,6 +131,8 @@ class ModelSerializer:
             zf.writestr(_CONFIG_ENTRY, net.conf.to_json())
             zf.writestr(_ARRAYS_ENTRY, buf.getvalue())
             zf.writestr(_STATE_ENTRY, json.dumps(training_state, indent=2))
+            if dtype_map:
+                zf.writestr(_DTYPES_ENTRY, json.dumps(dtype_map, indent=2))
 
     @staticmethod
     def _read(path: str) -> Tuple[str, Dict[str, np.ndarray], dict]:
@@ -110,6 +141,9 @@ class ModelSerializer:
             npz = np.load(io.BytesIO(zf.read(_ARRAYS_ENTRY)), allow_pickle=False)
             arrays = {k: npz[k] for k in npz.files}
             training_state = json.loads(zf.read(_STATE_ENTRY).decode("utf-8"))
+            if _DTYPES_ENTRY in zf.namelist():
+                dtype_map = json.loads(zf.read(_DTYPES_ENTRY).decode("utf-8"))
+                arrays = _restore_dtypes(arrays, dtype_map)
         return config_json, arrays, training_state
 
     @staticmethod

@@ -155,3 +155,23 @@ class TestROC:
         mroc.eval(y, p)
         assert mroc.calculate_average_auc() > 0.8
         assert 0 <= mroc.calculate_auc(0) <= 1.0
+
+
+class TestROCShapeHandling:
+    def test_two_col_predictions_one_col_labels(self):
+        """predictions [b,2] + labels [b] must use column 1 as the positive
+        probability (ADVICE r2 #5)."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        y = np.array([0, 1, 1, 0])
+        p2 = np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]])
+        roc2 = ROC(threshold_steps=10)
+        roc2.eval(y, p2)
+        roc1 = ROC(threshold_steps=10)
+        roc1.eval(y, p2[:, 1])
+        assert roc2.calculate_auc() == roc1.calculate_auc() == 1.0
+
+    def test_mismatched_lengths_raise(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        roc = ROC()
+        with pytest.raises(ValueError, match="labels"):
+            roc.eval(np.zeros(4), np.zeros((3, 5)))

@@ -344,3 +344,30 @@ class TestGraphSerialization:
         for a, b in zip(jax.tree_util.tree_leaves(ref.params),
                         jax.tree_util.tree_leaves(resumed.params)):
             assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+class TestOutputVertexWithConsumers:
+    def test_output_layer_feeding_downstream_vertex_trains(self, rng):
+        """A network-output layer that ALSO feeds another vertex must train
+        (reference ComputationGraph supports this; ADVICE r2 #1)."""
+        conf = (_base("sgd", 0.1).graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("out1", OutputLayer(n_out=4, activation="softmax",
+                                               loss="mcxent"), "d1")
+                .add_layer("out2", OutputLayer(n_out=3, activation="softmax",
+                                               loss="mcxent"), "out1")
+                .set_outputs("out1", "out2")
+                .set_input_types(InputType.feed_forward(5))
+                .build())
+        net = ComputationGraph(conf).init()
+        rng_np = np.random.default_rng(3)
+        x = rng_np.normal(size=(16, 5)).astype(np.float32)
+        y1 = _class_labels(rng_np, 16, 4)
+        y2 = _class_labels(rng_np, 16, 3)
+        s0 = net.score_for([x], [y1, y2])
+        for _ in range(20):
+            net.fit_batch([x], [y1, y2])
+        assert float(net.score()) < s0
+        out1, out2 = net.output(x)
+        assert out1.shape == (16, 4) and out2.shape == (16, 3)

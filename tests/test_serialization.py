@@ -95,3 +95,25 @@ class TestSaveRestore:
         save_model(net, p)
         restored = load_model(p)
         assert restored.conf.to_json() == net.conf.to_json()
+
+
+class TestExtensionDtypes:
+    def test_bf16_leaf_round_trips(self, tmp_path, rng):
+        """A bfloat16 leaf must survive the npz round-trip with its dtype
+        (np.savez alone would store it as raw void bytes; ADVICE r2 #3)."""
+        import jax.numpy as jnp
+        import ml_dtypes
+        from deeplearning4j_tpu.util.serialization import load_model, save_model
+        net = MultiLayerNetwork(_conf()).init()
+        # force one bf16 leaf into the layer state
+        key = next(iter(net.params))
+        net.state.setdefault(key, {})
+        net.state[key]["bf16_probe"] = jnp.asarray(
+            np.arange(8, dtype=np.float32), dtype=jnp.bfloat16)
+        path = str(tmp_path / "bf16.zip")
+        save_model(net, path)
+        restored = load_model(path)
+        probe = restored.state[key]["bf16_probe"]
+        assert np.dtype(probe.dtype) == np.dtype(ml_dtypes.bfloat16)
+        assert np.allclose(np.asarray(probe, dtype=np.float32),
+                           np.arange(8, dtype=np.float32))
