@@ -25,7 +25,7 @@ def _conv_bn(gb: GraphBuilder, name: str, inp: str, n_out: int,
              kernel, stride=(1, 1), activation: str = "relu") -> str:
     gb.add_layer(f"{name}_conv", ConvolutionLayer(
         n_out=n_out, kernel_size=tuple(kernel), stride=tuple(stride),
-        border_mode="same", activation="identity", bias_init=0.0), inp)
+        border_mode="same", activation="identity", has_bias=False), inp)
     gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
     if activation == "identity":
         return f"{name}_bn"

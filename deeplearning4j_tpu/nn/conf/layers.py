@@ -371,6 +371,8 @@ class ConvolutionLayer(Layer):
     dilation: Tuple[int, int] = (1, 1)
     border_mode: Optional[str] = None   # None=explicit pad | "same" | "valid"
     groups: int = 1
+    has_bias: bool = True           # False for conv+BN pairs (bias is
+                                    # redundant before BN's shift)
 
     def __post_init__(self):
         # ergonomic: padding="same"/"valid" routes to border_mode
@@ -409,8 +411,10 @@ class ConvolutionLayer(Layer):
 
     def param_shapes(self, policy=None):
         kh, kw = self.kernel_size
-        return {"W": (kh, kw, self.n_in // self.groups, self.n_out),
-                "b": (self.n_out,)}
+        shapes = {"W": (kh, kw, self.n_in // self.groups, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
 
     def init_params(self, key, policy=None):
         policy = policy or _dtypes.default_policy()
@@ -421,15 +425,20 @@ class ConvolutionLayer(Layer):
         w = init_weights(key, (kh, kw, self.n_in // self.groups, self.n_out),
                          self.weight_init or "XAVIER", fan_in=fan_in,
                          fan_out=fan_out, distribution=self.dist, dtype=dt)
-        b = jnp.full((self.n_out,), float(self.bias_init or 0.0), dt)
-        return {"W": w, "b": b}
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), float(self.bias_init or 0.0),
+                                   dt)
+        return params
 
     def pre_output(self, params, x, *, policy=None):
         policy = policy or _dtypes.default_policy()
         xc, wc = policy.cast_to_compute(x, params["W"])
         z = _convops.conv2d(xc, wc, self.stride, self._pad_arg(), self.dilation,
                             self.groups)
-        return z + params["b"].astype(z.dtype)
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        return z
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None, policy=None):
@@ -538,34 +547,31 @@ class BatchNormalization(Layer):
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None, policy=None):
+        from ...ops import batchnorm as _bn
         if not state:
             state = self.init_state(policy)
-        axes = tuple(range(x.ndim - 1))  # all but channel
         # statistics accumulate in the state dtype (f32 under mixed policy)
         # but the normalize+scale math stays in the activation dtype so
         # bf16 activations don't get promoted to f32 between conv blocks
         stat_dtype = state["mean"].dtype
+        if self.lock_gamma_beta:
+            g = jnp.full((x.shape[-1],), self.gamma, stat_dtype)
+            b = jnp.full((x.shape[-1],), self.beta, stat_dtype)
+        else:
+            g = params["gamma"].astype(stat_dtype)
+            b = params["beta"].astype(stat_dtype)
         if train:
-            xs = x.astype(stat_dtype)
-            mean = jnp.mean(xs, axis=axes)
-            var = jnp.var(xs, axis=axes)
+            # fused two-pass BN with a hand-written VJP (ops/batchnorm.py) —
+            # the autodiff backward of the naive form costs several extra HBM
+            # passes over the activation (the dominant ResNet train cost)
+            y, mean, var = _bn.batch_norm_train(x, g, b, self.eps)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
-        else:
-            mean, var = state["mean"], state["var"]
-            new_state = state
-        inv = jax.lax.rsqrt(var + self.eps)
-        if self.lock_gamma_beta:
-            scale = (self.gamma * inv).astype(x.dtype)
-            shift = (self.beta - self.gamma * mean * inv).astype(x.dtype)
-        else:
-            g32 = params["gamma"].astype(stat_dtype)
-            b32 = params["beta"].astype(stat_dtype)
-            scale = (g32 * inv).astype(x.dtype)
-            shift = (b32 - g32 * mean * inv).astype(x.dtype)
-        return x * scale + shift, new_state
+            return y, new_state
+        return _bn.batch_norm_inference(
+            x, g, b, state["mean"], state["var"], self.eps), state
 
 
 @register_layer("lrn")

@@ -415,11 +415,7 @@ class ComputationGraph:
         self._update_count += 1
         self._persist_states(new_states)
         self._score = loss
-        self.iteration_count += 1
-        for l in self.listeners:
-            if hasattr(l, "record_batch"):
-                l.record_batch(inputs[0].shape[0])
-            l.iteration_done(self, self.iteration_count, loss)
+        self._fire_iteration(inputs[0].shape[0], loss)
         return loss
 
     def fit(self, data, labels=None, *, epochs: int = 1) -> None:

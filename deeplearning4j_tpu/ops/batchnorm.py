@@ -1,0 +1,102 @@
+"""Fused batch-norm training kernel (forward + hand-written VJP).
+
+Role parity: the reference reaches cuDNN's fused BN through
+``CudnnBatchNormalizationHelper.java`` (``deeplearning4j-cuda/src/main/java/org/
+deeplearning4j/nn/layers/normalization/CudnnBatchNormalizationHelper.java``).
+On TPU the autodiff backward of a naive BN is the expensive path: XLA derives
+a chain of full-tensor f32 intermediates (upcast, mean/var VJPs) that cost
+several extra HBM passes over the activation. Profiling ResNet-50 showed BN
+at ~27 ms of a 57 ms train step. This module replaces it with the standard
+two-pass formulation and a custom VJP:
+
+  forward:  one fused pass for the f32-accumulated sums (mean, E[x^2]),
+            one pass to normalize in the activation dtype.
+  backward: one fused pass for (dbeta, dgamma), one pass for dx —
+            the textbook BN gradient, all elementwise work in the activation
+            dtype, reductions accumulated in the stats dtype.
+
+Stats reduce over all axes except the last (channel) axis — NHWC and [b, f]
+both work.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _reduce_axes(x):
+    return tuple(range(x.ndim - 1))
+
+
+def _n_elements(x) -> float:
+    return float(np.prod([x.shape[a] for a in _reduce_axes(x)]))
+
+
+def _forward(x, gamma, beta, eps):
+    """y, batch mean, biased batch var; stats in gamma's (f32/f64) dtype."""
+    axes = _reduce_axes(x)
+    n = _n_elements(x)
+    stat_dtype = gamma.dtype
+    mean = jnp.sum(x, axis=axes, dtype=stat_dtype) / n
+    s2 = jnp.sum(jnp.square(x.astype(stat_dtype)), axis=axes, dtype=stat_dtype)
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = (gamma * inv).astype(x.dtype)
+    shift = (beta - gamma * mean * inv).astype(x.dtype)
+    return x * scale + shift, mean, var
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def batch_norm_train(x, gamma, beta, eps):
+    """Training-mode BN. Returns (y, batch_mean, batch_var).
+
+    gamma/beta must be in the stats dtype (float32, or float64 under the f64
+    policy); x may be bf16/f32/f64. mean/var come back in the stats dtype for
+    the running-average update.
+    """
+    return _forward(x, gamma, beta, eps)
+
+
+def _vjp_fwd(x, gamma, beta, eps):
+    y, mean, var = _forward(x, gamma, beta, eps)
+    return (y, mean, var), (x, gamma, mean, var)
+
+
+def _vjp_bwd(eps, res, cts):
+    dy, dmean, dvar = cts
+    x, gamma, mean, var = res
+    axes = _reduce_axes(x)
+    n = _n_elements(x)
+    stat_dtype = gamma.dtype
+    inv = jax.lax.rsqrt(var + eps)
+    m_b = mean.astype(x.dtype)
+    xhat = (x - m_b) * inv.astype(x.dtype)
+    dbeta = jnp.sum(dy, axis=axes, dtype=stat_dtype)
+    dgamma = jnp.sum((dy * xhat).astype(stat_dtype), axis=axes,
+                     dtype=stat_dtype)
+    dx = (gamma * inv).astype(x.dtype) * (
+        dy
+        - (dbeta / n).astype(x.dtype)
+        - xhat * (dgamma / n).astype(x.dtype))
+    # exact cotangent contributions from the mean/var outputs (zero when they
+    # only feed the running-average state through a non-differentiated aux)
+    dmean_t = (dmean / n).astype(x.dtype)
+    dvar_t = (2.0 / n) * dvar.astype(x.dtype)
+    dx = dx + dmean_t + dvar_t * (x - m_b)
+    return dx, dgamma, dbeta
+
+
+batch_norm_train.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def batch_norm_inference(x, gamma, beta, mean, var, eps):
+    """Inference-mode BN from running stats (pure elementwise; XLA fuses it
+    into the preceding conv)."""
+    inv = jax.lax.rsqrt(var + eps)
+    scale = (gamma * inv).astype(x.dtype)
+    shift = (beta - gamma * mean * inv).astype(x.dtype)
+    return x * scale + shift

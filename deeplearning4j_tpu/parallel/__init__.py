@@ -21,8 +21,15 @@ TPU-native design — two modes, both expressed as XLA SPMD programs over a
   ``ParameterAveragingTrainingMaster.java:763-832``.
 """
 
+from .distributed import (global_mesh, host_local_batch, initialize,
+                          is_initialized, process_count, process_index)
 from .mesh import create_mesh, data_parallel_mesh, mesh_devices
+from .training_master import (ParameterAveragingTrainingMaster,
+                              SyncTrainingMaster, Trainer, TrainingMaster)
 from .wrapper import ParallelWrapper
 
 __all__ = ["ParallelWrapper", "create_mesh", "data_parallel_mesh",
-           "mesh_devices"]
+           "mesh_devices", "initialize", "is_initialized", "global_mesh",
+           "host_local_batch", "process_count", "process_index",
+           "TrainingMaster", "Trainer", "SyncTrainingMaster",
+           "ParameterAveragingTrainingMaster"]

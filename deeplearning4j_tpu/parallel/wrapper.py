@@ -30,8 +30,7 @@ def _tree_map(f, *trees):
     return jax.tree_util.tree_map(f, *trees, is_leaf=lambda x: x is None)
 
 
-def _is_graph(net) -> bool:
-    return hasattr(net, "topo_order")
+from ..util.netutil import is_graph as _is_graph
 
 
 def _net_states(net):

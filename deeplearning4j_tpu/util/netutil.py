@@ -1,0 +1,10 @@
+"""Small shared predicates over network runtimes."""
+
+from __future__ import annotations
+
+
+def is_graph(net) -> bool:
+    """True for ComputationGraph-shaped runtimes (DAG with a topo order),
+    False for MultiLayerNetwork-shaped ones. Structural, so subclasses and
+    wrappers classify correctly."""
+    return hasattr(net, "topo_order")
