@@ -14,7 +14,7 @@ the reference's prefetch thread with device affinity
 (``AsyncDataSetIterator.java:75-76``).
 """
 
-from .dataset import DataSet
+from .dataset import DataSet, MultiDataSet
 from .iterator import (
     ArrayDataSetIterator,
     AsyncDataSetIterator,
@@ -24,10 +24,16 @@ from .iterator import (
     MultipleEpochsIterator,
     SamplingDataSetIterator,
 )
-from .fetchers import IrisDataSetIterator, MnistDataSetIterator
+from .fetchers import (
+    CifarDataSetIterator,
+    IrisDataSetIterator,
+    LFWDataSetIterator,
+    MnistDataSetIterator,
+)
 
 __all__ = [
     "DataSet",
+    "MultiDataSet",
     "DataSetIterator",
     "ArrayDataSetIterator",
     "ListDataSetIterator",
@@ -37,4 +43,6 @@ __all__ = [
     "AsyncDataSetIterator",
     "MnistDataSetIterator",
     "IrisDataSetIterator",
+    "CifarDataSetIterator",
+    "LFWDataSetIterator",
 ]

@@ -104,3 +104,48 @@ class DataSet:
     def __repr__(self) -> str:
         return (f"DataSet(features={self.features.shape}, "
                 f"labels={self.labels.shape})")
+
+
+class MultiDataSet:
+    """Multiple named-position inputs/outputs for ComputationGraph training
+    (parity: ND4J ``MultiDataSet`` — lists of feature/label arrays + masks,
+    the currency of ``RecordReaderMultiDataSetIterator``)."""
+
+    def __init__(self, features: List, labels: List,
+                 features_masks: Optional[List] = None,
+                 labels_masks: Optional[List] = None):
+        self.features = [_as_array(f) for f in features]
+        self.labels = [_as_array(l) for l in labels]
+        self.features_masks = (None if features_masks is None
+                               else [_as_array(m) for m in features_masks])
+        self.labels_masks = (None if labels_masks is None
+                             else [_as_array(m) for m in labels_masks])
+
+    def num_examples(self) -> int:
+        return self.features[0].shape[0]
+
+    def num_inputs(self) -> int:
+        return len(self.features)
+
+    def num_outputs(self) -> int:
+        return len(self.labels)
+
+    @staticmethod
+    def merge(datasets: List["MultiDataSet"]) -> "MultiDataSet":
+        n_in = datasets[0].num_inputs()
+        n_out = datasets[0].num_outputs()
+        feats = [np.concatenate([d.features[i] for d in datasets], axis=0)
+                 for i in range(n_in)]
+        labels = [np.concatenate([d.labels[i] for d in datasets], axis=0)
+                  for i in range(n_out)]
+        fm = (None if datasets[0].features_masks is None else
+              [np.concatenate([d.features_masks[i] for d in datasets], axis=0)
+               for i in range(n_in)])
+        lm = (None if datasets[0].labels_masks is None else
+              [np.concatenate([d.labels_masks[i] for d in datasets], axis=0)
+               for i in range(n_out)])
+        return MultiDataSet(feats, labels, fm, lm)
+
+    def __repr__(self) -> str:
+        return (f"MultiDataSet(inputs={[f.shape for f in self.features]}, "
+                f"outputs={[l.shape for l in self.labels]})")

@@ -55,15 +55,23 @@ _MNIST_FILES = {
 }
 
 
-def _mnist_dirs():
+def _cache_dirs(*names: str):
+    """Candidate cache dirs for a dataset: ``$DL4J_TPU_DATA_DIR/<name>``
+    (plus the root itself), ``~/.cache/<name>``, ``~/.deeplearning4j/<name>``."""
     env = os.environ.get("DL4J_TPU_DATA_DIR")
     cands = []
     if env:
-        cands.append(Path(env) / "mnist")
+        for n in names:
+            cands.append(Path(env) / n)
         cands.append(Path(env))
-    cands.append(Path.home() / ".cache" / "mnist")
-    cands.append(Path.home() / ".deeplearning4j" / "MNIST")
+    for n in names:
+        cands.append(Path.home() / ".cache" / n)
+        cands.append(Path.home() / ".deeplearning4j" / n)
     return cands
+
+
+def _mnist_dirs():
+    return _cache_dirs("mnist", "MNIST")
 
 
 def _mnist_file(d: Path, key: str) -> Optional[Path]:
@@ -160,6 +168,175 @@ class MnistDataSetIterator(ArrayDataSetIterator):
             order = np.random.default_rng(seed).permutation(len(feats))
             feats, labels = feats[order], labels[order]
         super().__init__(feats.astype(np.float32), _one_hot(labels, 10), batch_size)
+
+
+# ----------------------------------------------------------------------
+# CIFAR-10 (binary-batch format) — CifarDataSetIterator.java:1-175 analog
+# ----------------------------------------------------------------------
+
+_CIFAR_TRAIN = [f"data_batch_{i}.bin" for i in range(1, 6)]
+_CIFAR_TEST = ["test_batch.bin"]
+_CIFAR_RECORD = 1 + 3 * 32 * 32  # label byte + CHW uint8 pixels
+
+
+def _cifar_dirs():
+    return _cache_dirs("cifar10", "cifar-10-batches-bin", "cifar")
+
+
+def _find_cifar(train: bool) -> Optional[Path]:
+    names = _CIFAR_TRAIN if train else _CIFAR_TEST
+    for d in _cifar_dirs():
+        if d.is_dir() and all((d / n).exists() for n in names):
+            return d
+    return None
+
+
+def read_cifar_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse one CIFAR-10 binary batch: each record is a label byte followed
+    by 3072 CHW uint8 pixels. Returns (images [n, 32, 32, 3] float32 in
+    [0,1] NHWC — the TPU-friendly layout — and labels [n])."""
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % _CIFAR_RECORD != 0:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of "
+                         f"{_CIFAR_RECORD}-byte CIFAR records")
+    recs = raw.reshape(-1, _CIFAR_RECORD)
+    labels = recs[:, 0].astype(np.int64)
+    imgs = recs[:, 1:].reshape(-1, 3, 32, 32).astype(np.float32) / 255.0
+    return imgs.transpose(0, 2, 3, 1), labels
+
+
+def _synthetic_cifar(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """32×32 RGB surrogate: class determines a dominant hue gradient plus a
+    textured patch, so a convnet can learn it but pixels aren't trivially
+    separable."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    yy, xx = np.mgrid[0:32, 0:32] / 31.0
+    imgs = np.zeros((n, 32, 32, 3), dtype=np.float32)
+    for i in range(n):
+        d = int(labels[i])
+        ang = 2 * np.pi * d / 10.0
+        base = 0.5 + 0.4 * np.cos(ang) * xx + 0.4 * np.sin(ang) * yy
+        img = np.stack([base * (0.4 + 0.06 * ((d + k) % 3))
+                        for k in range(3)], axis=-1)
+        cy, cx = rng.integers(8, 24, size=2)
+        img[cy - 4:cy + 4, cx - 4:cx + 4, d % 3] += 0.45
+        imgs[i] = img
+    imgs += rng.normal(0.0, 0.05, size=imgs.shape).astype(np.float32)
+    return np.clip(imgs, 0.0, 1.0), labels
+
+
+class CifarDataSetIterator(ArrayDataSetIterator):
+    """CIFAR-10 batches (parity: ``CifarDataSetIterator.java:1-175``).
+
+    Features ``[b, 32, 32, 3]`` NHWC float32 in [0,1] (the reference emits
+    CHW; NHWC keeps channels minor for XLA conv layouts), labels one-hot
+    ``[b, 10]``. Reads the standard binary-batch files from a local cache
+    dir; deterministic synthetic surrogate otherwise (``synthetic`` flag).
+    """
+
+    NUM_CLASSES = 10
+    LABELS = ["airplane", "automobile", "bird", "cat", "deer",
+              "dog", "frog", "horse", "ship", "truck"]
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 train: bool = True, shuffle: bool = True, seed: int = 123,
+                 flatten: bool = False):
+        d = _find_cifar(train)
+        self.synthetic = d is None
+        if d is not None:
+            names = _CIFAR_TRAIN if train else _CIFAR_TEST
+            parts = [read_cifar_bin(str(d / n)) for n in names]
+            feats = np.concatenate([p[0] for p in parts])
+            labels = np.concatenate([p[1] for p in parts])
+        else:
+            total = num_examples or (50000 if train else 10000)
+            feats, labels = _synthetic_cifar(
+                total, seed + (0 if train else 10_000_019))
+        if num_examples is not None:
+            feats, labels = feats[:num_examples], labels[:num_examples]
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(len(feats))
+            feats, labels = feats[order], labels[order]
+        if flatten:
+            feats = feats.reshape(len(feats), -1)
+        super().__init__(feats, _one_hot(labels, 10), batch_size)
+
+
+# ----------------------------------------------------------------------
+# LFW (labeled faces) — LFWDataSetIterator analog
+# ----------------------------------------------------------------------
+
+
+def _lfw_dirs():
+    return _cache_dirs("lfw")
+
+
+def _find_lfw() -> Optional[Path]:
+    """A directory is an LFW cache only if it actually holds the standard
+    ``<person>/*.jpg`` layout (a root cached for another dataset must fall
+    through to the synthetic surrogate, not crash the loader)."""
+    for d in _lfw_dirs():
+        if not d.is_dir():
+            continue
+        if any(p.is_dir() and any(p.glob("*.jpg")) for p in d.iterdir()):
+            return d
+    return None
+
+
+class LFWDataSetIterator(ArrayDataSetIterator):
+    """Labeled-faces batches (parity: ``LFWDataSetIterator.java``).
+
+    Scans ``<cache>/lfw/<person>/*.jpg`` directories (the standard LFW
+    layout), decodes + resizes via PIL, labels = person identity one-hot
+    over the ``num_labels`` most-photographed people. Synthetic face-like
+    surrogate (``synthetic`` flag) when the dataset is absent.
+    """
+
+    def __init__(self, batch_size: int, num_examples: int = 1000,
+                 num_labels: int = 10, image_shape: Tuple[int, int] = (64, 64),
+                 shuffle: bool = True, seed: int = 123):
+        d = _find_lfw()
+        self.synthetic = d is None
+        h, w = image_shape
+        if d is not None:
+            from PIL import Image
+            people = sorted((p for p in d.iterdir()
+                             if p.is_dir() and any(p.glob("*.jpg"))),
+                            key=lambda p: -len(list(p.glob("*.jpg"))))
+            people = people[:num_labels]
+            self.labels_list = [p.name for p in people]
+            feats, labels = [], []
+            for ci, person in enumerate(people):
+                for img_path in sorted(person.glob("*.jpg")):
+                    if len(feats) >= num_examples:
+                        break
+                    img = Image.open(img_path).convert("RGB").resize((w, h))
+                    feats.append(np.asarray(img, dtype=np.float32) / 255.0)
+                    labels.append(ci)
+            feats = np.stack(feats)
+            labels = np.asarray(labels)
+        else:
+            rng = np.random.default_rng(seed)
+            labels = rng.integers(0, num_labels, size=num_examples)
+            yy, xx = np.mgrid[0:h, 0:w]
+            feats = np.zeros((num_examples, h, w, 3), dtype=np.float32)
+            self.labels_list = [f"person_{i}" for i in range(num_labels)]
+            for i in range(num_examples):
+                c = int(labels[i])
+                # face-ish blob whose geometry depends on identity
+                cy, cx = h * (0.35 + 0.03 * (c % 5)), w * (0.5 + 0.02 * (c % 3))
+                r2 = ((yy - cy) / (0.30 * h)) ** 2 + ((xx - cx) / (0.22 * w)) ** 2
+                face = np.clip(1.2 - r2, 0, 1)
+                tone = 0.35 + 0.05 * (c % 7)
+                img = np.stack([face * (tone + 0.08 * k) for k in range(3)],
+                               axis=-1)
+                feats[i] = np.clip(
+                    img + rng.normal(0, 0.04, size=img.shape), 0, 1)
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(len(feats))
+            feats, labels = feats[order], labels[order]
+        super().__init__(feats, _one_hot(labels, num_labels), batch_size)
 
 
 class IrisDataSetIterator(ArrayDataSetIterator):

@@ -1,0 +1,268 @@
+"""Record readers: the ETL entry point (DataVec analog).
+
+Parity: the reference consumes the external DataVec library through
+``deeplearning4j-core/.../datasets/datavec/RecordReaderDataSetIterator.java``;
+the reader contracts mirrored here are DataVec's ``RecordReader`` /
+``SequenceRecordReader`` (``next()`` returning a list of Writables,
+``hasNext``, ``reset``, per-record ``RecordMetaData``).
+
+TPU-native design: a "record" is a plain Python list whose entries are
+numbers, strings (coerced lazily), or ``np.ndarray`` (the NDArrayWritable
+analog) — no Writable class hierarchy. Readers do host-side IO only; batch
+assembly into device-ready numpy arrays happens in
+``deeplearning4j_tpu.datavec.iterator``.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+from typing import Iterable, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+
+class RecordMetaData(NamedTuple):
+    """Provenance of one record (parity: DataVec ``RecordMetaData`` —
+    location + source URI, used by the reference's ``loadFromMetaData``)."""
+
+    index: int
+    source: str
+
+    def location(self) -> str:
+        return f"{self.source}:{self.index}"
+
+
+class RecordReader:
+    """One flat record per ``next_record()`` call.
+
+    Contract parity: DataVec ``RecordReader`` (``next``/``hasNext``/``reset``);
+    ``load_from_metadata`` mirrors ``RecordReaderMetaData`` record recovery.
+    """
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_record(self) -> List:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def record_metadata(self) -> Optional[RecordMetaData]:
+        """Metadata of the record most recently returned by next_record()."""
+        return None
+
+    def load_from_metadata(self, meta: Sequence[RecordMetaData]) -> List[List]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support metadata record loading")
+
+    @property
+    def labels(self) -> Optional[List[str]]:
+        return None
+
+    def __iter__(self):
+        while self.has_next():
+            yield self.next_record()
+
+
+class SequenceRecordReader(RecordReader):
+    """One sequence (list of timestep records) per ``next_sequence()``."""
+
+    def next_sequence(self) -> List[List]:
+        raise NotImplementedError
+
+
+def _parse_value(v):
+    """Coerce a CSV field to float when numeric; keep strings otherwise."""
+    if isinstance(v, str):
+        s = v.strip()
+        try:
+            return float(s)
+        except ValueError:
+            return s
+    return v
+
+
+class CSVRecordReader(RecordReader):
+    """CSV file/strings → records (parity: DataVec ``CSVRecordReader``).
+
+    ``skip_lines`` drops header rows; ``delimiter`` defaults to ','. Numeric
+    fields parse to float, everything else stays a string (converted or
+    one-hot-mapped downstream by the iterators).
+    """
+
+    def __init__(self, path: Optional[str] = None, skip_lines: int = 0,
+                 delimiter: str = ",", lines: Optional[Iterable[str]] = None):
+        if (path is None) == (lines is None):
+            raise ValueError("provide exactly one of path= or lines=")
+        self.path = path
+        self.skip_lines = int(skip_lines)
+        self.delimiter = delimiter
+        self._lines = None if lines is None else list(lines)
+        self._records: List[List] = []
+        self._cursor = 0
+        self._load()
+
+    def _load(self) -> None:
+        if self._lines is not None:
+            raw = self._lines
+        else:
+            with open(self.path, "r", newline="") as f:
+                raw = f.read().splitlines()
+        body = raw[self.skip_lines:]
+        reader = csv.reader(io.StringIO("\n".join(body)),
+                            delimiter=self.delimiter)
+        self._records = [[_parse_value(v) for v in row]
+                         for row in reader if row]
+
+    @property
+    def source(self) -> str:
+        return self.path if self.path is not None else "<memory>"
+
+    def has_next(self) -> bool:
+        return self._cursor < len(self._records)
+
+    def next_record(self) -> List:
+        if not self.has_next():
+            raise StopIteration
+        rec = self._records[self._cursor]
+        self._cursor += 1
+        return list(rec)
+
+    def record_metadata(self) -> RecordMetaData:
+        return RecordMetaData(self._cursor - 1, self.source)
+
+    def load_from_metadata(self, meta: Sequence[RecordMetaData]) -> List[List]:
+        return [list(self._records[m.index]) for m in meta]
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (parity: DataVec ``CollectionRecordReader``)."""
+
+    def __init__(self, records: Iterable[Sequence]):
+        self._records = [list(r) for r in records]
+        self._cursor = 0
+
+    def has_next(self) -> bool:
+        return self._cursor < len(self._records)
+
+    def next_record(self) -> List:
+        if not self.has_next():
+            raise StopIteration
+        rec = self._records[self._cursor]
+        self._cursor += 1
+        return list(rec)
+
+    def record_metadata(self) -> RecordMetaData:
+        return RecordMetaData(self._cursor - 1, "<collection>")
+
+    def load_from_metadata(self, meta: Sequence[RecordMetaData]) -> List[List]:
+        return [list(self._records[m.index]) for m in meta]
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+
+class LineRecordReader(RecordReader):
+    """One raw line per record (parity: DataVec ``LineRecordReader``)."""
+
+    def __init__(self, path: Optional[str] = None,
+                 lines: Optional[Iterable[str]] = None):
+        if (path is None) == (lines is None):
+            raise ValueError("provide exactly one of path= or lines=")
+        if path is not None:
+            with open(path, "r") as f:
+                self._lines = f.read().splitlines()
+        else:
+            self._lines = list(lines)
+        self.path = path
+        self._cursor = 0
+
+    def has_next(self) -> bool:
+        return self._cursor < len(self._lines)
+
+    def next_record(self) -> List:
+        if not self.has_next():
+            raise StopIteration
+        line = self._lines[self._cursor]
+        self._cursor += 1
+        return [line]
+
+    def record_metadata(self) -> RecordMetaData:
+        return RecordMetaData(self._cursor - 1, self.path or "<memory>")
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """Sequences from CSV: one file per sequence, or in-memory groups
+    (parity: DataVec ``CSVSequenceRecordReader`` — each file is a time
+    series, one row per timestep).
+    """
+
+    def __init__(self, paths: Optional[Sequence[str]] = None,
+                 skip_lines: int = 0, delimiter: str = ",",
+                 sequences: Optional[Sequence[Sequence[Sequence]]] = None):
+        if (paths is None) == (sequences is None):
+            raise ValueError("provide exactly one of paths= or sequences=")
+        self.skip_lines = int(skip_lines)
+        self.delimiter = delimiter
+        if sequences is not None:
+            self._sequences = [[list(step) for step in seq]
+                               for seq in sequences]
+            self._sources = ["<memory>"] * len(self._sequences)
+        else:
+            self._sequences = []
+            self._sources = []
+            for p in paths:
+                rr = CSVRecordReader(path=p, skip_lines=skip_lines,
+                                     delimiter=delimiter)
+                self._sequences.append(list(rr))
+                self._sources.append(p)
+        self._cursor = 0
+
+    def has_next(self) -> bool:
+        return self._cursor < len(self._sequences)
+
+    def next_sequence(self) -> List[List]:
+        if not self.has_next():
+            raise StopIteration
+        seq = self._sequences[self._cursor]
+        self._cursor += 1
+        return [list(s) for s in seq]
+
+    def next_record(self) -> List:  # flat view: one timestep at a time
+        return self.next_sequence()
+
+    def record_metadata(self) -> RecordMetaData:
+        return RecordMetaData(self._cursor - 1,
+                              self._sources[self._cursor - 1])
+
+    def load_from_metadata(self, meta) -> List[List[List]]:
+        return [[list(s) for s in self._sequences[m.index]] for m in meta]
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def __len__(self) -> int:
+        return len(self._sequences)
+
+
+class CollectionSequenceRecordReader(CSVSequenceRecordReader):
+    """In-memory sequence records (parity: DataVec
+    ``CollectionSequenceRecordReader``)."""
+
+    def __init__(self, sequences: Sequence[Sequence[Sequence]]):
+        super().__init__(sequences=sequences)

@@ -140,3 +140,70 @@ class TestFetchers:
         assert ds.features.shape == (150, 4)
         assert ds.labels.shape == (150, 3)
         assert np.allclose(ds.labels.sum(axis=0), [50, 50, 50])
+
+    def test_cifar_shapes(self):
+        from deeplearning4j_tpu.datasets import CifarDataSetIterator
+        it = CifarDataSetIterator(8, 32, seed=3)
+        ds = it.next()
+        assert ds.features.shape == (8, 32, 32, 3)
+        assert ds.labels.shape == (8, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        flat = CifarDataSetIterator(8, 16, flatten=True).next()
+        assert flat.features.shape == (8, 3072)
+
+    def test_cifar_binary_parser(self, tmp_path, monkeypatch):
+        """Exercise the REAL file path by writing a tiny valid binary batch."""
+        from deeplearning4j_tpu.datasets.fetchers import read_cifar_bin
+        rng = np.random.default_rng(0)
+        n = 7
+        recs = np.zeros((n, 3073), dtype=np.uint8)
+        recs[:, 0] = np.arange(n) % 10
+        recs[:, 1:] = rng.integers(0, 256, size=(n, 3072))
+        p = tmp_path / "data_batch_1.bin"
+        recs.tofile(p)
+        imgs, labels = read_cifar_bin(str(p))
+        assert imgs.shape == (n, 32, 32, 3)
+        assert labels.tolist() == [i % 10 for i in range(n)]
+        # CHW→HWC transpose correctness: red channel of record 0
+        np.testing.assert_allclose(
+            imgs[0, :, :, 0], recs[0, 1:1025].reshape(32, 32) / 255.0)
+        # full iterator path through a fake cache dir
+        from deeplearning4j_tpu.datasets import CifarDataSetIterator
+        cache = tmp_path / "cifar10"
+        cache.mkdir()
+        for name in ["data_batch_%d.bin" % i for i in range(1, 6)]:
+            recs.tofile(cache / name)
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        it = CifarDataSetIterator(5, train=True, shuffle=False)
+        assert not it.synthetic
+        ds = it.next()
+        assert ds.features.shape == (5, 32, 32, 3)
+
+    def test_lfw_shapes(self):
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        it = LFWDataSetIterator(4, num_examples=12, num_labels=5,
+                                image_shape=(32, 32))
+        ds = it.next()
+        assert ds.features.shape == (4, 32, 32, 3)
+        assert ds.labels.shape == (4, 5)
+
+    def test_lfw_real_directory(self, tmp_path, monkeypatch):
+        """Real LFW directory layout with generated jpegs via PIL."""
+        from PIL import Image
+        lfw = tmp_path / "lfw"
+        rng = np.random.default_rng(0)
+        for person, count in [("Alice_A", 3), ("Bob_B", 2)]:
+            d = lfw / person
+            d.mkdir(parents=True)
+            for i in range(count):
+                arr = rng.integers(0, 256, size=(48, 48, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"{person}_{i:04d}.jpg")
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        it = LFWDataSetIterator(5, num_examples=5, num_labels=2,
+                                image_shape=(24, 24), shuffle=False)
+        assert not it.synthetic
+        assert it.labels_list == ["Alice_A", "Bob_B"]
+        ds = it.next()
+        assert ds.features.shape == (5, 24, 24, 3)
+        assert ds.labels.shape == (5, 2)

@@ -1,0 +1,314 @@
+"""ETL bridge tests (parity: RecordReaderDataSetIterator.java behavior)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (
+    AlignmentMode,
+    CollectionRecordReader,
+    CollectionSequenceRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    LineRecordReader,
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+
+IRIS_ROWS = [
+    "5.1,3.5,1.4,0.2,0",
+    "4.9,3.0,1.4,0.2,0",
+    "7.0,3.2,4.7,1.4,1",
+    "6.4,3.2,4.5,1.5,1",
+    "6.3,3.3,6.0,2.5,2",
+    "5.8,2.7,5.1,1.9,2",
+]
+
+
+def test_csv_reader_parses_and_resets(tmp_path):
+    p = tmp_path / "iris.csv"
+    p.write_text("a,b,c,d,label\n" + "\n".join(IRIS_ROWS) + "\n")
+    rr = CSVRecordReader(path=str(p), skip_lines=1)
+    recs = list(rr)
+    assert len(recs) == 6
+    assert recs[0] == [5.1, 3.5, 1.4, 0.2, 0.0]
+    assert not rr.has_next()
+    rr.reset()
+    assert rr.has_next()
+
+
+def test_classification_one_hot():
+    rr = CSVRecordReader(lines=IRIS_ROWS)
+    it = RecordReaderDataSetIterator(rr, batch_size=4, label_index=4,
+                                     num_classes=3)
+    ds = it.next()
+    assert ds.features.shape == (4, 4)
+    assert ds.labels.shape == (4, 3)
+    np.testing.assert_allclose(ds.labels[0], [1, 0, 0])
+    np.testing.assert_allclose(ds.labels[2], [0, 1, 0])
+    ds2 = it.next()  # remainder batch
+    assert ds2.features.shape == (2, 4)
+    assert not it.has_next()
+    it.reset()
+    assert it.has_next()
+
+
+def test_string_labels_mapped():
+    rows = ["1.0,2.0,cat", "3.0,4.0,dog", "5.0,6.0,cat"]
+    rr = CSVRecordReader(lines=rows)
+    it = RecordReaderDataSetIterator(rr, batch_size=3, label_index=2,
+                                     num_classes=2)
+    ds = it.next()
+    np.testing.assert_allclose(ds.labels,
+                               [[1, 0], [0, 1], [1, 0]])
+
+
+def test_regression_multi_output():
+    rows = ["1,2,10,20", "3,4,30,40"]
+    rr = CSVRecordReader(lines=rows)
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                     label_index_to=3, regression=True)
+    ds = it.next()
+    np.testing.assert_allclose(ds.features, [[1, 2], [3, 4]])
+    np.testing.assert_allclose(ds.labels, [[10, 20], [30, 40]])
+
+
+def test_unsupervised_all_features():
+    rr = CollectionRecordReader([[1.0, 2.0], [3.0, 4.0]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2)
+    ds = it.next()
+    np.testing.assert_allclose(ds.features, [[1, 2], [3, 4]])
+    assert ds.labels is ds.features
+
+
+def test_ndarray_writable_flattened():
+    rr = CollectionRecordReader([
+        [np.arange(4, dtype=np.float32).reshape(2, 2), 1],
+        [np.ones((2, 2), dtype=np.float32), 0],
+    ])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=1,
+                                     num_classes=2)
+    ds = it.next()
+    assert ds.features.shape == (2, 4)
+    np.testing.assert_allclose(ds.features[0], [0, 1, 2, 3])
+
+
+def test_max_num_batches():
+    rr = CSVRecordReader(lines=IRIS_ROWS)
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=4,
+                                     num_classes=3, max_num_batches=2)
+    n = sum(1 for _ in it)
+    assert n == 2
+
+
+def test_metadata_collection_and_reload():
+    rr = CSVRecordReader(lines=IRIS_ROWS)
+    it = RecordReaderDataSetIterator(rr, batch_size=3, label_index=4,
+                                     num_classes=3, collect_metadata=True)
+    ds = it.next()
+    assert len(ds.example_metadata) == 3
+    # drill back into specific source records (loadFromMetaData parity)
+    back = it.load_from_metadata(ds.example_metadata[1:3])
+    np.testing.assert_allclose(back.features, ds.features[1:3])
+    np.testing.assert_allclose(back.labels, ds.labels[1:3])
+    # iterator continues where it left off
+    assert it.next().features.shape == (3, 4)
+
+
+def test_label_out_of_range_raises():
+    rr = CSVRecordReader(lines=["1,2,7"])
+    it = RecordReaderDataSetIterator(rr, batch_size=1, label_index=2,
+                                     num_classes=3)
+    with pytest.raises(ValueError, match="out of range"):
+        it.next()
+
+
+def test_sequence_single_reader_classification():
+    seqs = [
+        [[0.1, 0.2, 0], [0.3, 0.4, 1], [0.5, 0.6, 0]],
+        [[1.1, 1.2, 2], [1.3, 1.4, 2], [1.5, 1.6, 1]],
+    ]
+    rr = CollectionSequenceRecordReader(seqs)
+    it = SequenceRecordReaderDataSetIterator(rr, batch_size=2,
+                                             num_classes=3, label_index=2)
+    ds = it.next()
+    assert ds.features.shape == (2, 3, 2)
+    assert ds.labels.shape == (2, 3, 3)
+    assert ds.features_mask is None
+    np.testing.assert_allclose(ds.features[0, 1], [0.3, 0.4])
+    np.testing.assert_allclose(ds.labels[0, 1], [0, 1, 0])
+
+
+def test_sequence_dual_reader_align_end():
+    feats = CollectionSequenceRecordReader([
+        [[1.0], [2.0], [3.0]],
+        [[4.0], [5.0]],
+    ])
+    labels = CollectionSequenceRecordReader([
+        [[0], [1], [0]],
+        [[1], [1]],
+    ])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch_size=2, num_classes=2, labels_reader=labels,
+        alignment=AlignmentMode.ALIGN_END)
+    ds = it.next()
+    assert ds.features.shape == (2, 3, 1)
+    # short sequence is right-aligned: first step masked out
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [0, 1, 1]])
+    np.testing.assert_allclose(ds.features[1, :, 0], [0, 4, 5])
+
+
+def test_sequence_align_start_masks():
+    feats = CollectionSequenceRecordReader([
+        [[1.0], [2.0], [3.0]],
+        [[4.0]],
+    ])
+    labels = CollectionSequenceRecordReader([
+        [[0], [1], [0]],
+        [[1]],
+    ])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch_size=2, num_classes=2, labels_reader=labels,
+        alignment=AlignmentMode.ALIGN_START)
+    ds = it.next()
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [1, 0, 0]])
+    np.testing.assert_allclose(ds.features[1, :, 0], [4, 0, 0])
+
+
+def test_sequence_ragged_equal_length_raises():
+    feats = CollectionSequenceRecordReader([
+        [[1.0], [2.0]],
+        [[4.0]],
+    ])
+    labels = CollectionSequenceRecordReader([
+        [[0], [1]],
+        [[1]],
+    ])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch_size=2, num_classes=2, labels_reader=labels)
+    with pytest.raises(ValueError, match="differ in length"):
+        it.next()
+
+
+def test_csv_sequence_reader_files(tmp_path):
+    paths = []
+    for i in range(2):
+        p = tmp_path / f"seq{i}.csv"
+        p.write_text("\n".join(f"{i}.{t},{t}" for t in range(3)) + "\n")
+        paths.append(str(p))
+    rr = CSVSequenceRecordReader(paths=paths)
+    seq = rr.next_sequence()
+    assert len(seq) == 3
+    assert seq[1] == [0.1, 1.0]
+
+
+def test_multi_dataset_iterator():
+    rr = CSVRecordReader(lines=IRIS_ROWS)
+    it = (RecordReaderMultiDataSetIterator.Builder(batch_size=4)
+          .add_reader("csv", rr)
+          .add_input("csv", 0, 1)
+          .add_input("csv", 2, 3)
+          .add_output_one_hot("csv", 4, 3)
+          .build())
+    mds = it.next()
+    assert mds.num_inputs() == 2
+    assert mds.features[0].shape == (4, 2)
+    assert mds.features[1].shape == (4, 2)
+    assert mds.labels[0].shape == (4, 3)
+    mds2 = it.next()
+    assert mds2.features[0].shape == (2, 2)
+    it.reset()
+    assert it.has_next()
+
+
+def test_multi_dataset_trains_graph():
+    """MultiDataSet output feeds ComputationGraph.fit directly."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+    rr = CSVRecordReader(lines=IRIS_ROWS)
+    it = (RecordReaderMultiDataSetIterator.Builder(batch_size=6)
+          .add_reader("csv", rr)
+          .add_input("csv", 0, 3)
+          .add_output_one_hot("csv", 4, 3)
+          .build())
+    mds = it.next()
+    conf = (NeuralNetConfiguration.builder().seed(12345)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3), "h")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    loss = net.fit_batch(mds.features, mds.labels)
+    assert np.isfinite(float(loss))
+
+
+def test_missing_num_classes_raises_upfront():
+    rr = CSVRecordReader(lines=["1,2,cat", "3,4,dog"])
+    with pytest.raises(ValueError, match="num_classes"):
+        RecordReaderDataSetIterator(rr, batch_size=2, label_index=2)
+
+
+def test_reader_declared_labels_fix_width():
+    class LabeledReader(CollectionRecordReader):
+        @property
+        def labels(self):
+            return ["cat", "dog", "bird"]
+
+    rr = LabeledReader([[1.0, "cat"], [2.0, "dog"]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=1)
+    ds = it.next()
+    assert ds.labels.shape == (2, 3)
+    np.testing.assert_allclose(ds.labels, [[1, 0, 0], [0, 1, 0]])
+
+
+def test_dual_reader_length_mismatch_clear_error():
+    feats = CollectionSequenceRecordReader([[[1.0]], [[2.0]]])
+    labels = CollectionSequenceRecordReader([[[0]]])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch_size=2, num_classes=2, labels_reader=labels)
+    with pytest.raises(ValueError, match="exhausted"):
+        list(it)
+
+
+def test_multi_iterator_label_range_check():
+    rr = CSVRecordReader(lines=["1,2,7"])
+    it = (RecordReaderMultiDataSetIterator.Builder(batch_size=1)
+          .add_reader("csv", rr)
+          .add_input("csv", 0, 1)
+          .add_output_one_hot("csv", 2, 3)
+          .build())
+    with pytest.raises(ValueError, match="out of range"):
+        it.next()
+
+
+def test_multi_dataset_merge_preserves_masks():
+    from deeplearning4j_tpu.datasets import MultiDataSet
+    a = MultiDataSet([np.ones((2, 3))], [np.ones((2, 1))],
+                     [np.ones((2, 3))], [np.ones((2, 1))])
+    b = MultiDataSet([np.zeros((1, 3))], [np.zeros((1, 1))],
+                     [np.zeros((1, 3))], [np.zeros((1, 1))])
+    m = MultiDataSet.merge([a, b])
+    assert m.features_masks[0].shape == (3, 3)
+    assert m.labels_masks[0].shape == (3, 1)
+    np.testing.assert_allclose(m.features_masks[0][:, 0], [1, 1, 0])
+
+
+def test_lfw_foreign_cache_falls_back(tmp_path, monkeypatch):
+    """A data root cached for another dataset must not be mistaken for LFW."""
+    (tmp_path / "mnist").mkdir()
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    from deeplearning4j_tpu.datasets import LFWDataSetIterator
+    it = LFWDataSetIterator(2, num_examples=4, num_labels=2,
+                            image_shape=(16, 16))
+    assert it.synthetic
+    assert it.next().features.shape == (2, 16, 16, 3)
+
+
+def test_line_record_reader():
+    rr = LineRecordReader(lines=["hello world", "second line"])
+    assert rr.next_record() == ["hello world"]
+    assert rr.record_metadata().index == 0
