@@ -66,6 +66,7 @@ class StatsListener(TrainingListener):
         self.histogram_frequency = max(1, int(histogram_frequency))
         self._last_time: Optional[float] = None
         self._static_posted = False
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
 
     # -- listener hooks --
     def on_epoch_start(self, model, epoch: int) -> None:
@@ -123,16 +124,38 @@ class StatsListener(TrainingListener):
         self._static_posted = True
 
     def _param_stats(self, model) -> Dict[str, Any]:
+        """Per-parameter norms/histograms, plus the same for the last
+        inter-snapshot UPDATE (param delta — the reference's 'updates' view;
+        with a jitted+donated train step the raw gradient is fused away, so
+        the applied update is the observable quantity)."""
         import jax
         out = {}
+        prev = self._prev_params or {}
+        snap: Dict[str, np.ndarray] = {}
         flat = jax.tree_util.tree_flatten_with_path(model.params)[0]
         for path, leaf in flat:
             name = jax.tree_util.keystr(path)
             arr = np.asarray(leaf).ravel()
-            out[name] = {
+            snap[name] = arr
+            entry = {
                 "norm": float(np.linalg.norm(arr)),
                 "mean": float(arr.mean()),
                 "std": float(arr.std()),
                 "histogram": _histogram(arr),
             }
+            if name in prev and prev[name].shape == arr.shape:
+                upd = arr - prev[name]
+                entry["update"] = {
+                    "norm": float(np.linalg.norm(upd)),
+                    "mean": float(upd.mean()),
+                    "std": float(upd.std()),
+                    "histogram": _histogram(upd),
+                }
+                # ratio of update magnitude to param magnitude — the
+                # at-a-glance learning-rate health indicator
+                pn = float(np.linalg.norm(arr))
+                entry["update_ratio"] = (float(np.linalg.norm(upd) / pn)
+                                         if pn > 0 else 0.0)
+            out[name] = entry
+        self._prev_params = snap
         return out
