@@ -83,13 +83,19 @@ def _mnist_file(d: Path, key: str) -> Optional[Path]:
 
 def _find_mnist(train: bool) -> Optional[Path]:
     """Directory holding BOTH the image and label file for the requested
-    split, else None (→ synthetic fallback)."""
+    split; attempts an HTTP download into the cache when absent (parity:
+    ``MnistFetcher.java:43`` lazy download); None → synthetic fallback."""
     img_key = "train_images" if train else "test_images"
     lbl_key = "train_labels" if train else "test_labels"
     for d in _mnist_dirs():
         if not d.is_dir():
             continue
         if _mnist_file(d, img_key) and _mnist_file(d, lbl_key):
+            return d
+    from .downloader import auto_download_enabled, fetch_mnist
+    if auto_download_enabled():
+        d = fetch_mnist()
+        if d is not None and _mnist_file(d, img_key) and _mnist_file(d, lbl_key):
             return d
     return None
 
@@ -180,13 +186,22 @@ _CIFAR_RECORD = 1 + 3 * 32 * 32  # label byte + CHW uint8 pixels
 
 
 def _cifar_dirs():
-    return _cache_dirs("cifar10", "cifar-10-batches-bin", "cifar")
+    base = _cache_dirs("cifar10", "cifar-10-batches-bin", "cifar")
+    # fetch_cifar10 extracts to <cache>/cifar-10-batches-bin — scan those
+    # nested layouts too so cached downloads are found even with
+    # DL4J_TPU_AUTO_DOWNLOAD=0 (code review r4)
+    return base + [d / "cifar-10-batches-bin" for d in base]
 
 
 def _find_cifar(train: bool) -> Optional[Path]:
     names = _CIFAR_TRAIN if train else _CIFAR_TEST
     for d in _cifar_dirs():
         if d.is_dir() and all((d / n).exists() for n in names):
+            return d
+    from .downloader import auto_download_enabled, fetch_cifar10
+    if auto_download_enabled():
+        d = fetch_cifar10()
+        if d is not None and all((d / n).exists() for n in names):
             return d
     return None
 
@@ -253,11 +268,13 @@ class CifarDataSetIterator(ArrayDataSetIterator):
             total = num_examples or (50000 if train else 10000)
             feats, labels = _synthetic_cifar(
                 total, seed + (0 if train else 10_000_019))
-        if num_examples is not None:
-            feats, labels = feats[:num_examples], labels[:num_examples]
+        # shuffle BEFORE truncating: a subset must sample across the whole
+        # training set, not a deterministic prefix of data_batch_1 (ADVICE r3)
         if shuffle:
             order = np.random.default_rng(seed).permutation(len(feats))
             feats, labels = feats[order], labels[order]
+        if num_examples is not None:
+            feats, labels = feats[:num_examples], labels[:num_examples]
         if flatten:
             feats = feats.reshape(len(feats), -1)
         super().__init__(feats, _one_hot(labels, 10), batch_size)

@@ -182,11 +182,16 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self._batch_num = 0
         old_batch = self._batch
         self._batch = max(1, len(records))
+        # don't let the temporary CollectionRecordReader's metadata clobber
+        # the ongoing iteration's provenance state (ADVICE r3)
+        old_collect, old_last = self.collect_metadata, self.last_metadata
+        self.collect_metadata = False
         try:
             ds = self.next()
         finally:
             self.reader, self._batch_num = saved
             self._batch = old_batch
+            self.collect_metadata, self.last_metadata = old_collect, old_last
         ds.example_metadata = list(meta)
         return ds
 
@@ -226,7 +231,11 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         self.label_index = label_index
         self.regression = regression
         self.alignment = alignment
-        self._mapper = _LabelMapper(reader.labels)
+        # dual-reader mode: declared label ordering comes from the LABELS
+        # reader, not the features reader (ADVICE r3)
+        self._mapper = _LabelMapper(
+            labels_reader.labels if labels_reader is not None
+            else reader.labels)
         if labels_reader is None and label_index is None:
             raise ValueError(
                 "single-reader mode needs label_index; dual-reader mode "

@@ -60,7 +60,12 @@ class RecordReader:
 
     @property
     def labels(self) -> Optional[List[str]]:
-        return None
+        """Declared class-label ordering, if the source provides one."""
+        return getattr(self, "_declared_labels", None)
+
+    @labels.setter
+    def labels(self, value: Optional[List[str]]) -> None:
+        self._declared_labels = None if value is None else list(value)
 
     def __iter__(self):
         while self.has_next():
@@ -232,21 +237,44 @@ class CSVSequenceRecordReader(SequenceRecordReader):
                 self._sequences.append(list(rr))
                 self._sources.append(p)
         self._cursor = 0
+        self._flat_seq: Optional[List[List]] = None
+        self._flat_step = 0
 
     def has_next(self) -> bool:
-        return self._cursor < len(self._sequences)
+        if self._flat_seq is not None and self._flat_step < len(self._flat_seq):
+            return True
+        # flat-contract accuracy: only count remaining sequences that hold at
+        # least one timestep, so next_record() never raises after
+        # has_next()==True when empty sequences trail (code review r4)
+        return any(len(self._sequences[i]) > 0
+                   for i in range(self._cursor, len(self._sequences)))
 
     def next_sequence(self) -> List[List]:
-        if not self.has_next():
+        if self._cursor >= len(self._sequences):
             raise StopIteration
         seq = self._sequences[self._cursor]
         self._cursor += 1
+        self._flat_read = False
         return [list(s) for s in seq]
 
-    def next_record(self) -> List:  # flat view: one timestep at a time
-        return self.next_sequence()
+    def next_record(self) -> List:
+        """Flat RecordReader view: ONE timestep at a time, walking each
+        sequence in order — so this reader also composes with the flat
+        RecordReaderDataSetIterator contract."""
+        while self._flat_seq is None or self._flat_step >= len(self._flat_seq):
+            self._flat_seq = self.next_sequence()
+            self._flat_step = 0
+        self._flat_read = True
+        step = self._flat_seq[self._flat_step]
+        self._flat_step += 1
+        return list(step)
 
-    def record_metadata(self) -> RecordMetaData:
+    def record_metadata(self) -> Optional[RecordMetaData]:
+        # metadata here addresses whole SEQUENCES (load_from_metadata returns
+        # sequences); a flat timestep read has no per-record address, so it
+        # reports no metadata rather than an ambiguous/crashing one
+        if getattr(self, "_flat_read", False):
+            return None
         return RecordMetaData(self._cursor - 1,
                               self._sources[self._cursor - 1])
 
@@ -255,6 +283,9 @@ class CSVSequenceRecordReader(SequenceRecordReader):
 
     def reset(self) -> None:
         self._cursor = 0
+        self._flat_seq = None
+        self._flat_step = 0
+        self._flat_read = False
 
     def __len__(self) -> int:
         return len(self._sequences)

@@ -73,6 +73,49 @@ class KerasModelImport:
         return net
 
     @staticmethod
+    def import_model(path: str, *, train: bool = False, loss: str = "mcxent"):
+        """h5 → model, dispatching on the saved architecture class (parity:
+        ``Model.importModel`` ``keras/Model.java:95-128``): Sequential →
+        MultiLayerNetwork, Model/Functional → ComputationGraph."""
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            class_name = KerasModelImport._read_model_config(f)["class_name"]
+        if class_name == "Sequential":
+            return KerasModelImport.import_sequential_model(
+                path, train=train, loss=loss)
+        return KerasModelImport.import_functional_model(
+            path, train=train, loss=loss)
+
+    @staticmethod
+    def import_functional_model(path: str, *, train: bool = False,
+                                loss: str = "mcxent"):
+        """h5 functional-API model → initialized ComputationGraph with
+        imported weights (parity: ``Model.importFunctionalApiModel``
+        ``keras/Model.java:78``).
+
+        Keras merge layers map to graph vertices: Concatenate/Merge(concat) →
+        MergeVertex, Add/Merge(sum) → ElementWiseVertex(add), Subtract →
+        ElementWiseVertex(subtract), Multiply → ElementWiseVertex(product),
+        Average → ElementWiseVertex(average), Maximum → ElementWiseVertex(max).
+        Dense layers feeding network outputs become OutputLayers with `loss`
+        so the returned graph is trainable/evaluable."""
+        import h5py
+        from ..nn.graph_runtime import ComputationGraph
+
+        with h5py.File(path, "r") as f:
+            model_config = KerasModelImport._read_model_config(f)
+            class_name = model_config["class_name"]
+            if class_name == "Sequential":
+                raise ValueError(
+                    "sequential model; use import_sequential_model")
+            conf = KerasModelImport._build_functional_conf(
+                model_config["config"], loss)
+            net = ComputationGraph(conf).init()
+            KerasModelImport._load_graph_weights(f, net, model_config)
+        return net
+
+    @staticmethod
     def import_model_configuration(path_or_json: str, loss: str = "mcxent"):
         """Config-only import: model JSON (file path or string) →
         MultiLayerConfiguration (parity: ``ModelConfiguration``)."""
@@ -184,6 +227,141 @@ class KerasModelImport:
         conf._keras_classes = [c for _, c, _ in entries]
         return conf
 
+    # merge-layer class → vertex factory (keras 2 classes + keras 1 Merge
+    # modes; parity: the reference maps these onto MergeVertex /
+    # ElementWiseVertex in KerasLayer handling, Model.java:78-128)
+    _MERGE_OPS = {"Add": "add", "Subtract": "subtract",
+                  "Multiply": "product", "Average": "average",
+                  "Maximum": "max"}
+    _MERGE1_MODES = {"sum": "add", "mul": "product", "ave": "average",
+                     "max": "max"}
+
+    @staticmethod
+    def _build_functional_conf(config: dict, loss: str):
+        from ..nn.conf.graph import ElementWiseVertex, MergeVertex
+
+        layers = config["layers"]
+        output_refs = [o[0] for o in config["output_layers"]]
+        input_refs = [i[0] for i in config["input_layers"]]
+
+        builder = (NeuralNetConfiguration.builder().updater("sgd")
+                   .learning_rate(0.01).graph_builder())
+        builder.add_inputs(*input_refs)
+
+        alias: Dict[str, str] = {}   # keras name → actual vertex name
+        input_types: Dict[str, InputType] = {}
+        classes_by_name: Dict[str, str] = {}
+
+        def resolve(name: str) -> str:
+            while name in alias:
+                name = alias[name]
+            return name
+
+        for lc in layers:
+            cls = lc["class_name"]
+            cfg = lc.get("config", {})
+            name = lc.get("name") or cfg.get("name") or cls.lower()
+            fmt = KerasModelImport._data_format(cfg)
+            nodes = lc.get("inbound_nodes") or []
+            in_names = [resolve(ref[0]) for ref in (nodes[0] if nodes else [])]
+
+            if cls == "InputLayer":
+                it = KerasModelImport._input_type_of(cfg, fmt)
+                if it is not None:
+                    input_types[name] = it
+                continue
+
+            if cls == "Concatenate" or (
+                    cls == "Merge" and cfg.get("mode", "concat") == "concat"):
+                builder.add_vertex(name, MergeVertex(), *in_names)
+                classes_by_name[name] = cls
+                continue
+            if cls in KerasModelImport._MERGE_OPS or cls == "Merge":
+                op = (KerasModelImport._MERGE_OPS.get(cls)
+                      or KerasModelImport._MERGE1_MODES.get(cfg.get("mode")))
+                if op is None:
+                    raise ValueError(
+                        f"unsupported Merge mode {cfg.get('mode')!r}")
+                builder.add_vertex(name, ElementWiseVertex(op=op), *in_names)
+                classes_by_name[name] = cls
+                continue
+
+            layer = KerasModelImport._translate_layer(cls, cfg, fmt)
+            if layer is None:           # Flatten etc: pass-through alias
+                alias[name] = in_names[0]
+                continue
+            if name in output_refs and cls == "Dense":
+                # Dense at a network output → OutputLayer (trainable graph)
+                layer = OutputLayer(n_out=layer.n_out,
+                                    activation=layer.activation, loss=loss)
+            layers_out = layer if isinstance(layer, list) else [layer]
+            prev = in_names
+            for li, l in enumerate(layers_out):
+                vname = name if li == 0 else f"{name}__aux{li}"
+                builder.add_layer(vname, l, *prev)
+                classes_by_name[vname] = cls if li == 0 else "_Aux"
+                prev = [vname]
+            if len(layers_out) > 1:
+                alias[name] = prev[0]   # downstream consumers see the aux tail
+                classes_by_name[name] = cls  # weights live under keras name
+
+        builder.set_outputs(*[resolve(o) for o in output_refs])
+        if input_types:
+            missing = [i for i in input_refs if i not in input_types]
+            if missing:
+                # positional set_input_types would silently assign shapes to
+                # the wrong inputs — fail loudly instead
+                raise ValueError(
+                    f"InputLayer(s) {missing} declare no input shape while "
+                    f"{sorted(input_types)} do; cannot infer input types")
+            builder.set_input_types(*[input_types[i] for i in input_refs])
+        conf = builder.build()
+        conf._keras_classes_by_name = classes_by_name
+        return conf
+
+    @staticmethod
+    def _merge_translated_weights(net, key, lname: str, p: dict) -> None:
+        """Merge translated keras arrays into net.params[key] (running
+        mean/var go to net.state) with shape validation. Shared by the
+        sequential and functional loaders."""
+        import jax.numpy as jnp
+        cur = dict(net.params[key])
+        for pname, arr in p.items():
+            if pname in ("mean", "var"):
+                st = dict(net.state.get(key, {}))
+                st[pname] = jnp.asarray(arr)
+                net.state[key] = st
+            else:
+                if pname in cur and tuple(cur[pname].shape) != tuple(arr.shape):
+                    raise ValueError(
+                        f"{lname}/{pname}: shape {arr.shape} != expected "
+                        f"{cur[pname].shape}")
+                cur[pname] = jnp.asarray(arr)
+        net.params[key] = cur
+
+    @staticmethod
+    def _load_graph_weights(f, net, model_config: dict) -> None:
+        """Copy keras weights into ComputationGraph params by VERTEX NAME
+        (functional models address layers by name, reference Model.java:110)."""
+        group = KerasModelImport._weight_group(f)
+        classes = net.conf._keras_classes_by_name
+        fmt_by_name = {}
+        for lc in model_config["config"]["layers"]:
+            c = lc.get("config", {})
+            n = lc.get("name") or c.get("name")
+            fmt_by_name[n] = KerasModelImport._data_format(c)
+        for vname in net.topo_order:
+            cls = classes.get(vname)
+            if cls in (None, "_Aux"):
+                continue
+            arrays = KerasModelImport._layer_arrays(group, vname)
+            if not arrays:
+                continue
+            p = KerasModelImport._translate_weights(
+                cls, arrays, vname, fmt_by_name.get(vname, "channels_last"))
+            if p:
+                KerasModelImport._merge_translated_weights(net, vname, vname, p)
+
     @staticmethod
     def _translate_layer(cls: str, cfg: dict, fmt: str):
         act = _map_activation(cfg.get("activation"))
@@ -262,7 +440,6 @@ class KerasModelImport:
         group = KerasModelImport._weight_group(f)
         names = net.conf._keras_layer_names
         classes = net.conf._keras_classes
-        import jax.numpy as jnp
         for i, (lname, cls) in enumerate(zip(names, classes)):
             arrays = KerasModelImport._layer_arrays(group, lname)
             if not arrays:
@@ -274,21 +451,8 @@ class KerasModelImport:
                 if (c.get("name") or lc.get("name")) == lname:
                     fmt = KerasModelImport._data_format(c)
             p = KerasModelImport._translate_weights(cls, arrays, lname, fmt)
-            if not p:
-                continue
-            cur = dict(net.params[key])
-            for pname, arr in p.items():
-                if pname in ("mean", "var"):
-                    st = dict(net.state.get(key, {}))
-                    st[pname] = jnp.asarray(arr)
-                    net.state[key] = st
-                else:
-                    if pname in cur and cur[pname].shape != arr.shape:
-                        raise ValueError(
-                            f"{lname}/{pname}: shape {arr.shape} != expected "
-                            f"{cur[pname].shape}")
-                    cur[pname] = jnp.asarray(arr)
-            net.params[key] = cur
+            if p:
+                KerasModelImport._merge_translated_weights(net, key, lname, p)
 
     @staticmethod
     def _translate_weights(cls: str, arrays: Dict[str, np.ndarray],

@@ -17,7 +17,12 @@ Negative sampling and hierarchical softmax are both expressed this way; the
 random-window/subsampling logic runs in numpy on host.
 """
 
+from .documents import (
+    AsyncLabelAwareIterator, BasicLabelAwareIterator, FileDocumentIterator,
+    FileLabelAwareIterator, FilenamesLabelAwareIterator, LabelAwareIterator,
+    LabelledDocument, LabelsSource, SimpleLabelAwareIterator)
 from .glove import Glove
+from .inverted_index import InvertedIndex
 from .paragraph_vectors import ParagraphVectors
 from .sentence_iterator import (
     BasicLineIterator, CollectionSentenceIterator, FileSentenceIterator,
@@ -26,6 +31,7 @@ from .sequence_vectors import SequenceVectors
 from .tokenization import (
     DefaultTokenizer, DefaultTokenizerFactory, NGramTokenizerFactory,
     CommonPreprocessor)
+from .vectorizers import BagOfWordsVectorizer, TextVectorizer, TfidfVectorizer
 from .vocab import Huffman, VocabCache, VocabWord
 from .word2vec import Word2Vec, WordVectorSerializer
 
@@ -37,4 +43,10 @@ __all__ = [
     "SentenceIterator", "BasicLineIterator", "CollectionSentenceIterator",
     "FileSentenceIterator",
     "WordVectorSerializer",
+    "LabelledDocument", "LabelsSource", "LabelAwareIterator",
+    "SimpleLabelAwareIterator", "BasicLabelAwareIterator",
+    "FileLabelAwareIterator", "FilenamesLabelAwareIterator",
+    "AsyncLabelAwareIterator", "FileDocumentIterator",
+    "BagOfWordsVectorizer", "TfidfVectorizer", "TextVectorizer",
+    "InvertedIndex",
 ]

@@ -115,6 +115,41 @@ def hs_step(params, center, codes, points, code_mask, ctx, ctx_mask, lr, *,
     return params, loss / center.shape[0]
 
 
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("cbow",))
+def ns_step_scan(params, centers, targets, negss, ctxs, ctx_masks, lr, *,
+                 cbow=False):
+    """K negative-sampling SGD steps fused into ONE dispatch via lax.scan.
+
+    centers/targets [K,B], negss [K,B,N]; cbow adds ctxs/ctx_masks [K,B,W].
+    The on-chip inner loop for high-throughput vocab training — same update
+    semantics as calling :func:`ns_step` K times. Returns (params, [K] mean
+    losses).
+    """
+    def one(p, batch):
+        center, target, negs, ctx, ctx_mask = batch
+
+        def loss_fn(p):
+            if cbow:
+                vecs = jnp.take(p["syn0"], ctx, axis=0)
+                m = ctx_mask[..., None]
+                v = jnp.sum(vecs * m, axis=1) / jnp.maximum(
+                    jnp.sum(m, axis=1), 1.0)
+            else:
+                v = jnp.take(p["syn0"], center, axis=0)
+            return _ns_loss(p, v, target, negs)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p = jax.tree_util.tree_map(lambda a, g: a - lr * g, p, grads)
+        return p, loss / center.shape[0]
+
+    if ctxs is None:
+        k, b = centers.shape
+        ctxs = jnp.zeros((k, b, 1), jnp.int32)
+        ctx_masks = jnp.zeros((k, b, 1), jnp.float32)
+    return jax.lax.scan(one, params, (centers, targets, negss, ctxs,
+                                      ctx_masks))
+
+
 def build_unigram_table(counts: np.ndarray, power: float = 0.75,
                         table_size: int = 1 << 20) -> np.ndarray:
     """word2vec's unigram^0.75 negative-sampling table (parity: the

@@ -13,6 +13,7 @@ runtime analog — vertex boundaries disappear into XLA fusion.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -394,6 +395,71 @@ class ComputationGraph:
                 self._fire_iteration(batch_size, per_step[i])
         else:
             self.iteration_count += k
+        return losses
+
+    def _make_train_repeat(self):
+        """K train steps on ONE closed-over batch via lax.scan over step
+        indices — constant HBM regardless of K. Used by fit_repeated()."""
+        t = self.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = self._updater
+        base = _rng.key(t.seed)
+
+        def one(xs, ys, masks, carry, it):
+            params, opt_state, states = carry
+            rng = jax.random.fold_in(base, it)
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(
+                    params, states, xs, ys, masks, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, it)
+            params = _updaters.apply_updates(params, deltas)
+            kept = {name: {k: new_states[name].get(k, v)
+                           for k, v in st_old.items()}
+                    for name, st_old in states.items()}
+            return (params, opt_state, kept), loss
+
+        def repeat_steps(params, opt_state, states, xs, ys, masks, it0, k):
+            (params, opt_state, states), losses = jax.lax.scan(
+                functools.partial(one, xs, ys, masks),
+                (params, opt_state, states), it0 + jnp.arange(k))
+            return params, opt_state, states, losses
+
+        return jax.jit(repeat_steps, donate_argnums=(0, 1),
+                       static_argnums=(7,))
+
+    def fit_repeated(self, inputs, labels, k: int, masks=None):
+        """Run K optimizer updates on one pre-staged batch in a single device
+        dispatch (lax.scan over step indices). The on-chip analog of calling
+        ``fit_batch`` K times: same per-update rng folding, iteration counters,
+        and listener firing — but one dispatch and one batch of HBM. Used for
+        steady-state throughput measurement; returns [k] losses."""
+        inputs = [jnp.asarray(x) for x in _as_list(inputs)]
+        labels = [jnp.asarray(y) for y in _as_list(labels)]
+        if masks is not None:
+            masks = [None if m is None else jnp.asarray(m)
+                     for m in _as_list(masks)]
+        fn = self._jit_cache.get("train_repeat")
+        if fn is None:
+            fn = self._make_train_repeat()
+            self._jit_cache["train_repeat"] = fn
+        it0 = jnp.asarray(self._update_count, jnp.int32)
+        params, opt_state, new_states, losses = fn(
+            self.params, self.updater_state, self._states_map(), inputs,
+            labels, masks, it0, int(k))
+        self.params = params
+        self.updater_state = opt_state
+        self._update_count += int(k)
+        self._persist_states(new_states)
+        self._score = losses[-1]
+        if self.listeners:
+            batch_size = int(inputs[0].shape[0])
+            per_step = np.asarray(losses)
+            for i in range(int(k)):
+                self._fire_iteration(batch_size, per_step[i])
+        else:
+            self.iteration_count += int(k)
         return losses
 
     def fit_batch(self, inputs, labels, masks=None):

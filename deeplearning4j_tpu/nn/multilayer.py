@@ -405,6 +405,68 @@ class MultiLayerNetwork:
             self.iteration_count += k
         return losses
 
+    def _make_train_repeat(self):
+        """K train steps on ONE closed-over batch via lax.scan over step
+        indices — constant HBM regardless of K. Used by fit_repeated()."""
+        t = self.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = self._updater
+        base = _rng.key(t.seed)
+
+        def one(x, y, mask, carry, it):
+            params, opt_state, states = carry
+            rng = jax.random.fold_in(base, it)
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, it)
+            params = _updaters.apply_updates(params, deltas)
+            kept = [
+                {k: new_states[i].get(k, v) for k, v in st_old.items()}
+                for i, st_old in enumerate(states)]
+            return (params, opt_state, kept), loss
+
+        def repeat_steps(params, opt_state, states, x, y, mask, it0, k):
+            (params, opt_state, states), losses = jax.lax.scan(
+                functools.partial(one, x, y, mask), (params, opt_state, states),
+                it0 + jnp.arange(k))
+            return params, opt_state, states, losses
+
+        return jax.jit(repeat_steps, donate_argnums=(0, 1),
+                       static_argnums=(7,))
+
+    def fit_repeated(self, x, y, k: int, mask=None):
+        """Run K optimizer updates on one pre-staged batch in a single device
+        dispatch (lax.scan over step indices). The on-chip analog of calling
+        ``fit_batch(x, y)`` K times: same per-update rng folding, iteration
+        counters, and listener firing — but one dispatch and one batch of HBM.
+        Used for steady-state throughput measurement; returns [k] losses."""
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if mask is not None:
+            mask = jnp.asarray(mask)
+        fn = self._jit_cache.get("train_repeat")
+        if fn is None:
+            fn = self._make_train_repeat()
+            self._jit_cache["train_repeat"] = fn
+        it0 = jnp.asarray(self._update_count, jnp.int32)
+        params, opt_state, new_states, losses = fn(
+            self.params, self.updater_state, self._states_list(), x, y,
+            mask, it0, int(k))
+        self.params = params
+        self.updater_state = opt_state
+        self._update_count += int(k)
+        self._persist_states(new_states)
+        self._score = losses[-1]
+        if self.listeners:
+            batch_size = int(x.shape[0])
+            per_step = np.asarray(losses)
+            for i in range(int(k)):
+                self._fire_iteration(batch_size, per_step[i])
+        else:
+            self.iteration_count += int(k)
+        return losses
+
     # ------------------------------------------------------------------
     # fit (parity: fit(DataSetIterator) :1037, doTruncatedBPTT :1079)
     # ------------------------------------------------------------------

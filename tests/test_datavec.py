@@ -312,3 +312,64 @@ def test_line_record_reader():
     rr = LineRecordReader(lines=["hello world", "second line"])
     assert rr.next_record() == ["hello world"]
     assert rr.record_metadata().index == 0
+
+
+def test_sequence_reader_flat_record_contract():
+    """next_record() walks ONE timestep at a time (ADVICE r3): the flat
+    RecordReader view must compose with RecordReaderDataSetIterator."""
+    seqs = [[[0.0, 1.0], [2.0, 3.0]], [[4.0, 5.0]]]
+    rr = CSVSequenceRecordReader(sequences=seqs)
+    flat = []
+    while rr.has_next():
+        flat.append(rr.next_record())
+    assert flat == [[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]]
+    rr.reset()
+    assert rr.next_sequence() == [[0.0, 1.0], [2.0, 3.0]]
+
+
+def test_dual_reader_label_ordering_from_labels_reader():
+    """Dual-reader mode maps string labels using the LABELS reader's declared
+    ordering (ADVICE r3)."""
+    from deeplearning4j_tpu.datavec.readers import CollectionSequenceRecordReader
+    from deeplearning4j_tpu.datavec.iterator import (
+        SequenceRecordReaderDataSetIterator)
+
+    feats = CollectionSequenceRecordReader([[[0.1], [0.2]], [[0.3], [0.4]]])
+    labels = CollectionSequenceRecordReader([[["b"], ["b"]], [["a"], ["a"]]])
+    labels.labels = ["a", "b"]  # declared ordering: a -> 0, b -> 1
+    it = SequenceRecordReaderDataSetIterator(
+        feats, batch_size=2, num_classes=2, labels_reader=labels)
+    ds = it.next()
+    import numpy as np
+    # first sequence is all "b" -> index 1
+    assert np.argmax(np.asarray(ds.labels)[0, 0]) == 1
+    assert np.argmax(np.asarray(ds.labels)[1, 0]) == 0
+
+
+def test_load_from_metadata_preserves_provenance():
+    """load_from_metadata must not clobber last_metadata of the ongoing
+    iteration (ADVICE r3)."""
+    from deeplearning4j_tpu.datavec.readers import CollectionRecordReader
+    from deeplearning4j_tpu.datavec.iterator import RecordReaderDataSetIterator
+
+    recs = [[float(i), float(i % 2)] for i in range(6)]
+    rr = CollectionRecordReader(recs)
+    it = RecordReaderDataSetIterator(rr, batch_size=3, num_classes=2,
+                                     label_index=1, collect_metadata=True)
+    it.next()
+    before = list(it.last_metadata)
+    assert len(before) == 3
+    ds = it.load_from_metadata(before[:2])
+    assert it.last_metadata == before
+    assert it.collect_metadata is True
+    assert len(ds.example_metadata) == 2
+
+
+def test_sequence_reader_empty_sequences_flat_contract():
+    """has_next() must be accurate for the flat view when empty sequences
+    remain (code review r4)."""
+    rr = CSVSequenceRecordReader(sequences=[[[1.0]], [], [[2.0]], []])
+    flat = []
+    while rr.has_next():
+        flat.append(rr.next_record())
+    assert flat == [[1.0], [2.0]]

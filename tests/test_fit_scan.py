@@ -114,3 +114,62 @@ class TestFitScanListeners:
         net.fit_scan([xs], [ys])
         assert len(collector.scores) == 4
         assert net.iteration_count == 4
+
+
+class TestFitRepeated:
+    def test_matches_fit_batch_loop_same_batch(self, rng):
+        """fit_repeated(x, y, k) == calling fit_batch(x, y) k times (no
+        dropout → rng path identical per update index)."""
+        import jax
+        xs, ys = _batches(rng, k=1)
+        x, y = xs[0], ys[0]
+        ref = MultiLayerNetwork(_conf(updater="adam", lr=0.01)).init()
+        for _ in range(5):
+            ref.fit_batch(x, y)
+        net = MultiLayerNetwork(_conf(updater="adam", lr=0.01)).init()
+        losses = net.fit_repeated(x, y, 5)
+        assert losses.shape == (5,)
+        assert net.iteration_count == 5 and net._update_count == 5
+        for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                        jax.tree_util.tree_leaves(net.params)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_bn_state_persists(self, rng):
+        import jax
+        xs, ys = _batches(rng, k=1)
+        ref = MultiLayerNetwork(_conf(with_bn=True)).init()
+        for _ in range(4):
+            ref.fit_batch(xs[0], ys[0])
+        net = MultiLayerNetwork(_conf(with_bn=True)).init()
+        net.fit_repeated(xs[0], ys[0], 4)
+        for a, b in zip(jax.tree_util.tree_leaves(ref.state),
+                        jax.tree_util.tree_leaves(net.state)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_graph_fit_repeated(self, rng):
+        import jax
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater("nesterovs").momentum(0.9).learning_rate(0.05)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("bn", BatchNormalization(), "d")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "bn")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(5))
+                .build())
+        xs, ys = _batches(rng, k=1)
+        ref = ComputationGraph(conf).init()
+        for _ in range(4):
+            ref.fit_batch([xs[0]], [ys[0]])
+        net = ComputationGraph(conf).init()
+        losses = net.fit_repeated([xs[0]], [ys[0]], 4)
+        assert losses.shape == (4,)
+        for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                        jax.tree_util.tree_leaves(net.params)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(ref.state),
+                        jax.tree_util.tree_leaves(net.state)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)

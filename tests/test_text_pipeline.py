@@ -1,0 +1,191 @@
+"""Text-pipeline breadth tests: label-aware document iterators, BagOfWords /
+TF-IDF vectorizers, inverted index (parity model: reference
+``bagofwords/vectorizer`` + ``text/documentiterator`` + ``text/invertedindex``
+test coverage)."""
+
+import math
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import (
+    AsyncLabelAwareIterator, BagOfWordsVectorizer, BasicLabelAwareIterator,
+    FileLabelAwareIterator, InvertedIndex, LabelledDocument, LabelsSource,
+    SimpleLabelAwareIterator, TfidfVectorizer)
+
+DOCS = [
+    LabelledDocument("the cat sat on the mat", ["animals"]),
+    LabelledDocument("the dog chased the cat", ["animals"]),
+    LabelledDocument("stocks fell on tuesday", ["finance"]),
+]
+
+
+class TestLabelsSource:
+    def test_declared_labels(self):
+        ls = LabelsSource(["a", "b"])
+        assert ls.index_of("b") == 1
+        assert ls.index_of("zz") == -1
+        ls.store_label("c")
+        assert ls.labels == ["a", "b", "c"]
+
+    def test_template_generation(self):
+        ls = LabelsSource(template="DOC_%d")
+        assert ls.next_label() == "DOC_0"
+        assert ls.next_label() == "DOC_1"
+        assert ls.size() == 2
+
+
+class TestDocumentIterators:
+    def test_simple_iterator(self):
+        it = SimpleLabelAwareIterator(DOCS)
+        docs = list(it)
+        assert len(docs) == 3
+        assert docs[0].label == "animals"
+        assert it.labels_source.labels == ["animals", "finance"]
+        it.reset()
+        assert it.has_next()
+
+    def test_basic_iterator_generates_labels(self):
+        it = BasicLabelAwareIterator(["one sentence", "two sentence"])
+        docs = list(it)
+        assert [d.label for d in docs] == ["DOC_0", "DOC_1"]
+
+    def test_file_label_aware(self, tmp_path):
+        (tmp_path / "pos").mkdir()
+        (tmp_path / "neg").mkdir()
+        (tmp_path / "pos" / "a.txt").write_text("good great")
+        (tmp_path / "neg" / "b.txt").write_text("bad awful")
+        it = FileLabelAwareIterator(str(tmp_path))
+        docs = list(it)
+        assert {d.label for d in docs} == {"pos", "neg"}
+        assert sorted(it.labels_source.labels) == ["neg", "pos"]
+
+    def test_async_wrapper_delivers_all(self):
+        base = SimpleLabelAwareIterator(DOCS * 10)
+        it = AsyncLabelAwareIterator(base, buffer_size=4)
+        docs = list(it)
+        assert len(docs) == 30
+        it.reset()
+        assert len(list(it)) == 30
+
+
+class TestVectorizers:
+    def test_bag_of_words_counts(self):
+        v = BagOfWordsVectorizer()
+        it = SimpleLabelAwareIterator(DOCS)
+        ds = v.fit_transform(it)
+        x = np.asarray(ds.features)
+        assert x.shape == (3, v.vocab_size)
+        # "the" occurs twice in each animal doc
+        the = v.index_of("the")
+        assert the >= 0
+        assert x[0, the] == 2.0
+        assert x[2, the] == 0.0
+        # labels are one-hot in labels_source order
+        y = np.asarray(ds.labels)
+        assert y.shape == (3, 2)
+        assert y[0, v.labels_source.index_of("animals")] == 1.0
+        assert y[2, v.labels_source.index_of("finance")] == 1.0
+
+    def test_min_word_frequency_filters(self):
+        v = BagOfWordsVectorizer(min_word_frequency=2)
+        v.fit([d.content for d in DOCS])
+        assert v.index_of("tuesday") == -1   # appears once
+        assert v.index_of("cat") >= 0        # appears twice
+
+    def test_tfidf_downweights_common_words(self):
+        v = TfidfVectorizer()
+        v.fit([d.content for d in DOCS])
+        # "the" is in 2/3 docs, "stocks" in 1/3 → idf(stocks) > idf(the)
+        assert v.idf("stocks") > v.idf("the")
+        vec = v.transform("stocks stocks the")
+        s, t = v.index_of("stocks"), v.index_of("the")
+        assert vec[s] == 2.0 * v.idf("stocks")
+        assert vec[t] == 1.0 * v.idf("the")
+        assert math.isclose(v.idf("stocks"), math.log(3 / 1) + 1.0)
+
+    def test_vectorize_returns_dataset(self):
+        v = TfidfVectorizer()
+        it = SimpleLabelAwareIterator(DOCS)
+        v.fit(it)
+        ds = v.vectorize("the cat", "animals")
+        assert np.asarray(ds.features).shape == (1, v.vocab_size)
+        assert np.asarray(ds.labels)[0, v.labels_source.index_of("animals")] == 1.0
+
+    def test_trains_classifier(self):
+        """End-to-end: TF-IDF features train a softmax classifier."""
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        v = TfidfVectorizer()
+        ds = v.fit_transform(SimpleLabelAwareIterator(DOCS * 8))
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater("adam").learning_rate(0.05).list()
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(v.vocab_size))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        for _ in range(30):
+            net.fit_batch(x, y)
+        acc = (np.argmax(np.asarray(net.output(x)), axis=1)
+               == np.argmax(y, axis=1)).mean()
+        assert acc == 1.0
+
+
+class TestInvertedIndex:
+    def test_postings(self):
+        idx = InvertedIndex()
+        for d in DOCS:
+            idx.add_words_to_doc(None, d.content.split())
+        assert idx.num_documents() == 3
+        assert idx.documents("cat") == [0, 1]
+        assert idx.documents("stocks") == [2]
+        assert idx.documents("zebra") == []
+        assert idx.num_documents_containing("the") == 2
+        assert idx.document(2) == ["stocks", "fell", "on", "tuesday"]
+        assert idx.total_words() == sum(len(d.content.split()) for d in DOCS)
+
+    def test_sampling_and_batches(self):
+        idx = InvertedIndex()
+        for i in range(10):
+            idx.add_words_to_doc(None, [f"w{i}", "shared"])
+        s = idx.sample_docs(4, seed=1)
+        assert len(s) == 4 and len(set(s)) == 4
+        batches = list(idx.batches(3))
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+        seen = []
+        idx.eachdoc(lambda toks, i: seen.append(i))
+        assert seen == list(range(10))
+
+
+class TestReviewRegressions:
+    def test_inverted_index_reextend_no_duplicates(self):
+        idx = InvertedIndex()
+        idx.add_words_to_doc(None, ["a"])
+        idx.add_words_to_doc(None, ["a"])
+        idx.add_word_to_doc(0, "a")
+        assert idx.documents("a") == [0, 1]
+        assert idx.num_documents_containing("a") == 2
+
+    def test_async_reset_does_not_reread_corpus(self):
+        """reset() must signal the producer to stop, not drain the full
+        base iterator."""
+        reads = []
+
+        class CountingIterator(SimpleLabelAwareIterator):
+            def next_document(self):
+                d = super().next_document()
+                reads.append(1)
+                return d
+
+        base = CountingIterator(DOCS * 100)
+        it = AsyncLabelAwareIterator(base, buffer_size=4)
+        it.next_document()  # consume one, then reset mid-stream
+        it.reset()
+        n_after_reset = len(reads)
+        # producer must NOT have walked all 300 docs to reach a sentinel
+        assert n_after_reset < 50
+        assert len(list(it)) == 300
