@@ -79,7 +79,7 @@ def l1(labels, pre, activation):
     return jnp.abs(_activate(pre, activation) - labels)
 
 
-@register("xent", "binary_xent", "reconstruction_crossentropy")
+@register("xent", "binary_xent", "binary_crossentropy", "reconstruction_crossentropy")
 def xent(labels, pre, activation):
     """Binary cross-entropy. Fused in logit space when activation is sigmoid."""
     if activation.lower() == "sigmoid":
@@ -89,7 +89,7 @@ def xent(labels, pre, activation):
     return -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
 
 
-@register("mcxent", "negativeloglikelihood")
+@register("mcxent", "negativeloglikelihood", "categorical_crossentropy")
 def mcxent(labels, pre, activation):
     """Multi-class cross-entropy. Fused log-softmax when activation is softmax."""
     if activation.lower() == "softmax":

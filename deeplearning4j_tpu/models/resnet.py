@@ -13,12 +13,14 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import numpy as np
+
 from ..nn.conf.builders import NeuralNetConfiguration
 from ..nn.conf.graph import ElementWiseVertex, GraphBuilder
 from ..nn.conf.inputs import InputType
 from ..nn.conf.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, GlobalPoolingLayer,
-    OutputLayer, SubsamplingLayer)
+    OutputLayer, SpaceToDepthLayer, SubsamplingLayer)
 
 
 def _conv_bn(gb: GraphBuilder, name: str, inp: str, n_out: int,
@@ -55,18 +57,28 @@ def resnet(blocks: Sequence[int] = (3, 4, 6, 3), *,
            height: int = 224, width: int = 224, channels: int = 3,
            n_classes: int = 1000, width_base: int = 64,
            updater: str = "sgd", learning_rate: float = 0.1,
-           momentum: float = 0.9, seed: int = 42, dtype: str = "mixed_bf16"):
+           momentum: float = 0.9, seed: int = 42, dtype: str = "mixed_bf16",
+           stem: str = "conv7"):
     """Bottleneck ResNet as a ComputationGraphConfiguration.
 
     ``blocks=(3,4,6,3)`` → ResNet-50. Smaller test nets: ``blocks=(1,1)``,
     reduced ``width_base``/image size.
+
+    ``stem="space_to_depth"`` lowers the 7×7/2 stem to an equivalent 4×4/1
+    conv on a 2×2 space-to-depth input (the MLPerf-style MXU-friendly stem;
+    ``fold_stem_7x7_to_s2d`` maps 7×7 weights onto it exactly).
     """
     b = (NeuralNetConfiguration.builder()
          .seed(seed).updater("nesterovs" if updater == "sgd" else updater)
          .momentum(momentum).learning_rate(learning_rate).dtype(dtype)
          .weight_init("RELU"))
     gb = b.graph_builder().add_inputs("in")
-    stem = _conv_bn(gb, "stem", "in", width_base, (7, 7), (2, 2), "relu")
+    if stem == "space_to_depth":
+        gb.add_layer("stem_s2d", SpaceToDepthLayer(block_size=2), "in")
+        stem = _conv_bn(gb, "stem", "stem_s2d", width_base, (4, 4), (1, 1),
+                        "relu")
+    else:
+        stem = _conv_bn(gb, "stem", "in", width_base, (7, 7), (2, 2), "relu")
     gb.add_layer("stem_pool", SubsamplingLayer(
         kernel_size=(3, 3), stride=(2, 2), border_mode="same",
         pooling_type="max"), stem)
@@ -88,3 +100,26 @@ def resnet(blocks: Sequence[int] = (3, 4, 6, 3), *,
 def resnet50(**kw):
     """ResNet-50 (ImageNet geometry by default)."""
     return resnet((3, 4, 6, 3), **kw)
+
+
+def fold_stem_7x7_to_s2d(w7: np.ndarray) -> np.ndarray:
+    """Map 7×7/2 stem weights [7,7,C,O] (SAME pad → (2,3)) onto the exact
+    equivalent 4×4/1 kernel [4,4,4C,O] over a 2×2 space-to-depth input
+    (SAME pad → (1,2)); s2d channel order (di, dj, c).
+
+    Derivation: output tap kh ∈ [0,7) reads x[2i + kh − 2]; writing
+    kh − 2 = 2u + di (u ∈ [−1,2], di ∈ {0,1}) makes it a 4-tap conv over
+    s2d rows with block-offset channel di — the (u=2, di=1) slot (kh=7)
+    stays zero. Same for kw.
+    """
+    kh_, kw_, c, o = w7.shape
+    if (kh_, kw_) != (7, 7):
+        raise ValueError(f"expected a 7x7 kernel, got {w7.shape}")
+    w4 = np.zeros((4, 4, 4 * c, o), dtype=w7.dtype)
+    for kh in range(7):
+        u, di = divmod(kh - 2, 2)
+        for kw in range(7):
+            v, dj = divmod(kw - 2, 2)
+            ch = (di * 2 + dj) * c
+            w4[u + 1, v + 1, ch:ch + c, :] = w7[kh, kw]
+    return w4

@@ -150,6 +150,48 @@ def ns_step_scan(params, centers, targets, negss, ctxs, ctx_masks, lr, *,
                                       ctx_masks))
 
 
+def make_sharded_ns_step(mesh, *, cbow: bool = False, axis: str = "data"):
+    """Data-parallel negative-sampling step over a device mesh.
+
+    Parity: the reference's distributed embedding training is Spark
+    word2vec (``dl4j-spark-nlp/.../word2vec/Word2Vec.java`` — partitions
+    train replicas, driver averages). TPU-native design: the PAIR BATCH is
+    sharded over the mesh's ``axis``; params stay replicated, and because
+    the loss is a sum over pairs XLA inserts the gradient all-reduce over
+    ICI — per-step exact synchronization (strictly stronger than the
+    reference's per-partition averaging), zero parameter shipping.
+
+    Returns a jitted ``step(params, center, target, negs, ctx, ctx_mask,
+    lr) -> (params, mean_loss)``; batch length must divide by the mesh
+    axis size.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(axis))
+
+    def step(params, center, target, negs, ctx, ctx_mask, lr):
+        def loss_fn(p):
+            if cbow:
+                vecs = jnp.take(p["syn0"], ctx, axis=0)
+                m = ctx_mask[..., None]
+                v = jnp.sum(vecs * m, axis=1) / jnp.maximum(
+                    jnp.sum(m, axis=1), 1.0)
+            else:
+                v = jnp.take(p["syn0"], center, axis=0)
+            return _ns_loss(p, v, target, negs)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params = jax.tree_util.tree_map(lambda a, g: a - lr * g, params,
+                                        grads)
+        return params, loss / center.shape[0]
+
+    return jax.jit(
+        step, donate_argnums=(0,),
+        in_shardings=(repl, shard, shard, shard, shard, shard, repl),
+        out_shardings=(repl, repl))
+
+
 def build_unigram_table(counts: np.ndarray, power: float = 0.75,
                         table_size: int = 1 << 20) -> np.ndarray:
     """word2vec's unigram^0.75 negative-sampling table (parity: the

@@ -34,7 +34,8 @@ class SequenceVectors:
                  sample: float = 0.0, learning_rate: float = 0.025,
                  min_learning_rate: float = 1e-4, epochs: int = 1,
                  batch_size: int = 4096, use_cbow: bool = False,
-                 seed: int = 42, vocab_limit: Optional[int] = None):
+                 seed: int = 42, vocab_limit: Optional[int] = None,
+                 mesh=None):
         self.layer_size = layer_size
         self.window = window
         self.negative = negative
@@ -47,6 +48,12 @@ class SequenceVectors:
         self.use_cbow = use_cbow
         self.seed = seed
         self.vocab_limit = vocab_limit
+        # data-parallel training: pair batches sharded over mesh's "data"
+        # axis, params replicated, grads all-reduced by XLA (parity role:
+        # dl4j-spark-nlp's distributed Word2Vec; see
+        # learning.make_sharded_ns_step). NS mode only.
+        self.mesh = mesh
+        self._sharded_step = None
 
         self.vocab: Optional[VocabCache] = None
         self.params: Optional[Dict] = None
@@ -170,11 +177,28 @@ class SequenceVectors:
                          self.learning_rate * (1.0 - frac))
                 if self.negative > 0:
                     negs = self._draw_negatives(rng, target)
-                    self.params, _ = _learning.ns_step(
-                        self.params, jnp.asarray(center), jnp.asarray(target),
-                        jnp.asarray(negs), jnp.asarray(ctx),
-                        jnp.asarray(ctx_mask), jnp.float32(lr),
-                        cbow=self.use_cbow)
+                    if self.mesh is not None:
+                        n_dev = self.mesh.shape["data"]
+                        keep = (len(center) // n_dev) * n_dev
+                        if keep == 0:
+                            continue  # tail smaller than the mesh: skip
+                        if self._sharded_step is None:
+                            self._sharded_step = \
+                                _learning.make_sharded_ns_step(
+                                    self.mesh, cbow=self.use_cbow)
+                        self.params, _ = self._sharded_step(
+                            self.params, jnp.asarray(center[:keep]),
+                            jnp.asarray(target[:keep]),
+                            jnp.asarray(negs[:keep]),
+                            jnp.asarray(ctx[:keep]),
+                            jnp.asarray(ctx_mask[:keep]), jnp.float32(lr))
+                    else:
+                        self.params, _ = _learning.ns_step(
+                            self.params, jnp.asarray(center),
+                            jnp.asarray(target),
+                            jnp.asarray(negs), jnp.asarray(ctx),
+                            jnp.asarray(ctx_mask), jnp.float32(lr),
+                            cbow=self.use_cbow)
                 else:
                     codes = self._codes[target]
                     points = self._points[target]

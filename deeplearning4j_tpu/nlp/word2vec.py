@@ -80,6 +80,12 @@ class Word2Vec(SequenceVectors):
     def fit(self, sequences=None, resettable: bool = True) -> "Word2Vec":
         if sequences is None:
             sequences = self._token_sequences()
+        else:
+            # Raw sentence strings go through the tokenizer factory, same as
+            # the configured sentence source (ref SentenceTransformer.java).
+            sequences = [self.tokenizer_factory.create(s).get_tokens()
+                         if isinstance(s, str) else s
+                         for s in sequences]
         super().fit(sequences, resettable)
         return self
 

@@ -485,6 +485,40 @@ class SubsamplingLayer(Layer):
                                self.stride, pad, self.pnorm), state
 
 
+@register_layer("space_to_depth")
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """NHWC space-to-depth: [b, h, w, c] → [b, h/bs, w/bs, bs²·c], channel
+    order (di, dj, c) over the bs×bs block.
+
+    Parity: the reference line later ships ``SpaceToDepthLayer``; here it
+    doubles as the TPU stem lowering — a 7×7/2 conv on 3 input channels
+    (3-deep contracting dim starves the 128-lane MXU) becomes an equivalent
+    4×4/1 conv on 12 channels after 2×2 space-to-depth
+    (``models.resnet.fold_stem_7x7_to_s2d`` maps the weights exactly).
+    """
+
+    block_size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        bs = self.block_size
+        if input_type.height % bs or input_type.width % bs:
+            raise ValueError(
+                f"space_to_depth block {bs} does not divide "
+                f"{input_type.height}x{input_type.width}")
+        return InputType.convolutional(
+            input_type.height // bs, input_type.width // bs,
+            input_type.channels * bs * bs)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None, policy=None):
+        bs = self.block_size
+        b, h, w, c = x.shape
+        x = x.reshape(b, h // bs, bs, w // bs, bs, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(b, h // bs, w // bs, bs * bs * c), state
+
+
 @register_layer("batch_norm")
 @dataclasses.dataclass
 class BatchNormalization(Layer):

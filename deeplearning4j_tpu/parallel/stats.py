@@ -1,0 +1,163 @@
+"""Distributed-training phase timing: per-phase events, summaries, HTML
+timeline export.
+
+Parity: reference Spark training stats — ``CommonSparkTrainingStats.java`` /
+``StatsCalculationHelper`` (phase timers around split/repartition/fit/
+aggregate) and ``StatsUtils.java:69-92`` ``exportStatsAsHtml`` (timeline
+chart per phase). Here the phases are the TPU pipeline's: host batch prep,
+sharded step dispatch, replica averaging, net sync, epoch boundaries.
+
+Honesty note on async dispatch: XLA returns control before the device
+finishes, so a ``step`` phase measures dispatch unless ``blocking=True``
+(which inserts a ``block_until_ready`` barrier — accurate per-step wall time
+at some throughput cost; the reference has no such distinction because ND4J
+ops were synchronous).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html
+import json
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class PhaseEvent:
+    """One timed occurrence of a phase (parity: ``BaseEventStats``)."""
+
+    phase: str
+    start_ms: float
+    duration_ms: float
+
+
+class TrainingStats:
+    """Collects phase events during distributed training.
+
+    ``blocking=True`` waits for device results inside timed sections so step
+    durations are true device times, not dispatch times.
+    """
+
+    def __init__(self, blocking: bool = False):
+        self.blocking = blocking
+        self.events: List[PhaseEvent] = []
+        self._origin = time.perf_counter()
+
+    def _now_ms(self) -> float:
+        return (time.perf_counter() - self._origin) * 1000.0
+
+    @contextmanager
+    def time_phase(self, phase: str, result_holder: Optional[list] = None):
+        """Context manager timing one phase occurrence. If ``blocking`` and
+        ``result_holder`` ends up holding device values, waits on them before
+        closing the measurement."""
+        t0 = self._now_ms()
+        try:
+            yield
+        finally:
+            if self.blocking and result_holder:
+                import jax
+                for leaf in jax.tree_util.tree_leaves(result_holder):
+                    if hasattr(leaf, "block_until_ready"):
+                        leaf.block_until_ready()
+            self.events.append(PhaseEvent(phase, t0, self._now_ms() - t0))
+
+    def record(self, phase: str, start_ms: float, duration_ms: float) -> None:
+        self.events.append(PhaseEvent(phase, start_ms, duration_ms))
+
+    # ------------------------------------------------------------------
+    # summaries (parity: CommonSparkTrainingStats getValue/statsAsString)
+    # ------------------------------------------------------------------
+
+    def phases(self) -> List[str]:
+        seen: Dict[str, None] = {}
+        for e in self.events:
+            seen.setdefault(e.phase, None)
+        return list(seen)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for p in self.phases():
+            ds = [e.duration_ms for e in self.events if e.phase == p]
+            out[p] = {
+                "count": len(ds),
+                "total_ms": round(sum(ds), 3),
+                "mean_ms": round(sum(ds) / len(ds), 3),
+                "min_ms": round(min(ds), 3),
+                "max_ms": round(max(ds), 3),
+            }
+        return out
+
+    def as_json(self) -> str:
+        return json.dumps({
+            "summary": self.summary(),
+            "events": [dataclasses.asdict(e) for e in self.events],
+        })
+
+    # ------------------------------------------------------------------
+    # HTML timeline (parity: StatsUtils.exportStatsAsHtml :69-92)
+    # ------------------------------------------------------------------
+
+    _COLORS = ["#4c78a8", "#f58518", "#54a24b", "#e45756", "#72b7b2",
+               "#b279a2", "#eeca3b", "#9d755d"]
+
+    def export_html(self, path: str, title: str = "Training phase timeline"
+                    ) -> None:
+        """Standalone HTML: one swimlane per phase, a rect per event."""
+        phases = self.phases()
+        if not self.events:
+            end = 1.0
+        else:
+            end = max(e.start_ms + e.duration_ms for e in self.events)
+        width, lane_h, label_w = 960.0, 28.0, 160.0
+        scale = (width - label_w - 20) / max(end, 1e-9)
+        rows = []
+        for i, p in enumerate(phases):
+            y = 30 + i * lane_h
+            color = self._COLORS[i % len(self._COLORS)]
+            rows.append(
+                f'<text x="4" y="{y + 18}" font-size="12">'
+                f'{html.escape(p)}</text>')
+            for e in self.events:
+                if e.phase != p:
+                    continue
+                x = label_w + e.start_ms * scale
+                w = max(e.duration_ms * scale, 0.75)
+                rows.append(
+                    f'<rect x="{x:.2f}" y="{y + 4}" width="{w:.2f}" '
+                    f'height="{lane_h - 8}" fill="{color}">'
+                    f'<title>{html.escape(p)}: {e.duration_ms:.2f} ms @ '
+                    f'{e.start_ms:.1f} ms</title></rect>')
+        height = 40 + len(phases) * lane_h
+        summary_rows = "".join(
+            f"<tr><td>{html.escape(p)}</td><td>{s['count']}</td>"
+            f"<td>{s['total_ms']}</td><td>{s['mean_ms']}</td>"
+            f"<td>{s['min_ms']}</td><td>{s['max_ms']}</td></tr>"
+            for p, s in self.summary().items())
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>{html.escape(title)}</title>
+<style>body{{font-family:sans-serif;margin:20px}}
+table{{border-collapse:collapse}}td,th{{border:1px solid #ccc;
+padding:4px 8px;font-size:13px}}</style></head><body>
+<h2>{html.escape(title)}</h2>
+<svg width="{width:.0f}" height="{height:.0f}">{''.join(rows)}</svg>
+<h3>Per-phase summary</h3>
+<table><tr><th>phase</th><th>count</th><th>total ms</th><th>mean ms</th>
+<th>min ms</th><th>max ms</th></tr>{summary_rows}</table>
+</body></html>"""
+        with open(path, "w") as f:
+            f.write(doc)
+
+
+@contextmanager
+def maybe_time_phase(stats: Optional[TrainingStats], phase: str,
+                     result_holder: Optional[list] = None):
+    """Null-safe ``time_phase``: a no-op when stats collection is off, so
+    call sites need only one copy of the timed body."""
+    if stats is None:
+        yield
+    else:
+        with stats.time_phase(phase, result_holder):
+            yield

@@ -42,6 +42,19 @@ class TrainingMaster(abc.ABC):
     ``fit``/``fit_batch``/``finish`` mirror the per-split execution.
     """
 
+    def __init__(self, collect_stats: bool = False,
+                 blocking_stats: bool = False):
+        self.collect_stats = collect_stats
+        self.blocking_stats = blocking_stats
+
+    def _stats(self):
+        """Phase-timing collector when ``collect_stats`` is on (parity:
+        ``TrainingMaster.setCollectTrainingStats``)."""
+        if not self.collect_stats:
+            return None
+        from .stats import TrainingStats
+        return TrainingStats(blocking=self.blocking_stats)
+
     @abc.abstractmethod
     def build(self, net, mesh: Optional[Mesh] = None) -> "Trainer":
         """Bind the strategy to a network + mesh, returning a Trainer."""
@@ -65,13 +78,31 @@ class Trainer:
         """Reconcile any un-averaged replica state into the network."""
         self._pw.finish()
 
+    def stats(self) -> Optional[dict]:
+        """Per-phase timing summary when the master was built with
+        ``collect_stats=True`` (parity: ``SparkTrainingStats``); else None."""
+        if self._pw.stats is None:
+            return None
+        return self._pw.stats.summary()
+
+    def training_stats(self):
+        """The raw TrainingStats collector (events + HTML export), or None."""
+        return self._pw.stats
+
+    def export_stats_html(self, path: str) -> None:
+        """Timeline chart export (parity: ``StatsUtils.java:69-92``)."""
+        if self._pw.stats is None:
+            raise ValueError("build the master with collect_stats=True")
+        self._pw.stats.export_html(path)
+
 
 class SyncTrainingMaster(TrainingMaster):
     """Per-step synchronous SPMD: batch sharded over ``data``, params
     replicated, XLA inserts the gradient all-reduce over ICI/DCN."""
 
     def build(self, net, mesh: Optional[Mesh] = None) -> Trainer:
-        return Trainer(ParallelWrapper(net, mesh=mesh, averaging_frequency=1))
+        return Trainer(ParallelWrapper(net, mesh=mesh, averaging_frequency=1,
+                                       stats=self._stats()))
 
 
 class ParameterAveragingTrainingMaster(TrainingMaster):
@@ -82,13 +113,17 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     The reference's builder knobs that still mean something here are kept;
     Spark plumbing knobs (repartitioning, export mode, RDD splits) have no
     analog — there is no data shipping to orchestrate.
+    ``collect_stats`` mirrors ``TrainingMaster.setCollectTrainingStats``.
     """
 
-    def __init__(self, averaging_frequency: int = 5):
+    def __init__(self, averaging_frequency: int = 5,
+                 collect_stats: bool = False, blocking_stats: bool = False):
+        super().__init__(collect_stats, blocking_stats)
         if averaging_frequency < 1:
             raise ValueError("averaging_frequency must be >= 1")
         self.averaging_frequency = int(averaging_frequency)
 
     def build(self, net, mesh: Optional[Mesh] = None) -> Trainer:
         return Trainer(ParallelWrapper(
-            net, mesh=mesh, averaging_frequency=self.averaging_frequency))
+            net, mesh=mesh, averaging_frequency=self.averaging_frequency,
+            stats=self._stats()))

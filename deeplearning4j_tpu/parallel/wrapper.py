@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
 from .mesh import data_parallel_mesh
+from .stats import maybe_time_phase
 
 Pytree = Any
 
@@ -76,7 +77,7 @@ class ParallelWrapper:
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None,
-                 averaging_frequency: int = 1):
+                 averaging_frequency: int = 1, stats=None):
         if net.params is None:
             net.init()
         self.net = net
@@ -86,6 +87,12 @@ class ParallelWrapper:
         self.averaging_frequency = int(averaging_frequency)
         self.n_devices = self.mesh.shape["data"]
         self._local: Optional[_LocalSgdState] = None
+        # phase timing (parity: SparkTrainingStats / StatsCalculationHelper);
+        # stats=True builds a default collector, or pass a TrainingStats
+        if stats is True:
+            from .stats import TrainingStats
+            stats = TrainingStats()
+        self.stats = stats or None
         if self.averaging_frequency == 1:
             # install the sharded step into the net's jit cache: net.fit then
             # runs SPMD transparently
@@ -148,7 +155,7 @@ class ParallelWrapper:
     # ------------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1, mask=None) -> None:
-        if self.averaging_frequency == 1:
+        if self.averaging_frequency == 1 and self.stats is None:
             if _is_graph(self.net):
                 if mask is not None:
                     raise ValueError(
@@ -158,19 +165,64 @@ class ParallelWrapper:
             else:
                 self.net.fit(data, labels, epochs=epochs, mask=mask)
             return
-        local = self._ensure_local()
+        if self.averaging_frequency == 1 and _is_graph(self.net) \
+                and mask is not None:
+            raise ValueError(
+                "ComputationGraph: pass masks via DataSet batches, "
+                "not the mask kwarg")
+        local = (self._ensure_local()
+                 if self.averaging_frequency > 1 else None)
         net = self.net
-        for _ in range(epochs):
+        for epoch in range(epochs):
             for l in net.listeners:
                 l.on_epoch_start(net, net.epoch_count)
-            for x, y, m in net._as_batches(data, labels, mask):
-                local.fit_batch(x, y, m)
+            batch_iter = iter(net._as_batches(data, labels, mask))
+            n_batches = 0
+            while True:
+                with maybe_time_phase(self.stats, "batch_prep"):
+                    batch = next(batch_iter, None)
+                if batch is None:
+                    break
+                n_batches += 1
+                x, y, m = batch
+                if local is not None:
+                    self._timed_local_step(local, x, y, m)
+                else:
+                    self._timed_sync_step(x, y, m)
+            if n_batches == 0 and epoch > 0:
+                raise ValueError(
+                    f"epoch {epoch} yielded no batches — the data iterator is "
+                    "exhausted and not resettable; pass arrays/DataSets or a "
+                    "resettable iterator for multi-epoch fit")
             for l in net.listeners:
                 l.on_epoch_end(net, net.epoch_count)
             net.epoch_count += 1
             if hasattr(data, "reset"):
                 data.reset()
-        local.sync_to_net()
+        if local is not None:
+            self._timed_sync_to_net(local)
+
+    def _timed_sync_step(self, x, y, mask):
+        holder = []
+        with maybe_time_phase(self.stats, "step", holder):
+            loss = self.net.fit_batch(x, y, mask)
+            holder.append(loss)
+        return loss
+
+    def _timed_local_step(self, local, x, y, mask):
+        holder = []
+        with maybe_time_phase(self.stats, "step", holder):
+            loss = local.fit_batch(x, y, mask)
+            holder.append(loss)
+        if local._steps_since_avg == 0:
+            self._timed_sync_to_net(local)
+        return loss
+
+    def _timed_sync_to_net(self, local):
+        holder = []
+        with maybe_time_phase(self.stats, "sync_to_net", holder):
+            local.sync_to_net()
+            holder.append(self.net.params)
 
     def fit_batch(self, x, y, mask=None) -> float:
         """One update. In local-SGD mode replicas step independently and the
@@ -179,12 +231,8 @@ class ParallelWrapper:
         averaging point — call :meth:`finish` (or ``average_now``) after the
         last batch to flush a partial window."""
         if self.averaging_frequency == 1:
-            return self.net.fit_batch(x, y, mask)
-        local = self._ensure_local()
-        loss = local.fit_batch(x, y, mask)
-        if local._steps_since_avg == 0:  # an average just ran: publish it
-            local.sync_to_net()
-        return loss
+            return self._timed_sync_step(x, y, mask)
+        return self._timed_local_step(self._ensure_local(), x, y, mask)
 
     def finish(self) -> None:
         """Flush local-SGD replicas into the wrapped net (average + sync)."""
@@ -287,9 +335,12 @@ class _LocalSgdState:
     def average(self) -> None:
         """Parameter + updater-state + layer-state averaging
         (parity: ``ParallelWrapper.java:145,:163-186``)."""
-        self.params = self._avg(self.params)
-        self.opt_state = self._avg(self.opt_state)
-        self.states = self._avg(self.states)
+        holder = []
+        with maybe_time_phase(self.pw.stats, "average", holder):
+            self.params = self._avg(self.params)
+            self.opt_state = self._avg(self.opt_state)
+            self.states = self._avg(self.states)
+            holder.append(self.params)
         self._steps_since_avg = 0
 
     def sync_to_net(self) -> None:

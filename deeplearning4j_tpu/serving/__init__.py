@@ -1,0 +1,13 @@
+"""Model serving: HTTP inference endpoint over ``output()``.
+
+Parity: reference ``dl4j-streaming``'s serving route
+(``streaming/routes/DL4jServeRouteBuilder.java`` — Camel route feeding
+records to a loaded model and publishing predictions) and the record
+serde (``serde/RecordSerializer.java``). TPU-native replacement: a
+dependency-free HTTP server with request micro-batching (batches amortize
+dispatch and keep the MXU fed) and hot model swap.
+"""
+
+from .server import InferenceServer
+
+__all__ = ["InferenceServer"]

@@ -1,0 +1,187 @@
+"""HTTP inference server with micro-batching.
+
+Endpoints:
+  POST /predict   {"inputs": [[...], ...]} → {"outputs": [[...], ...]}
+  GET  /healthz   {"ok": true, "model": "...", "served": N}
+  POST /model     swap the served model from a checkpoint zip path
+                  {"path": "/path/to/model.zip"}
+
+Design: requests land in a queue; a batcher thread coalesces up to
+``max_batch`` examples (waiting at most ``batch_timeout_ms`` after the
+first) into ONE ``model.output`` call — the serving analog of
+AsyncDataSetIterator's prefetch coalescing, and the right shape for a
+compiled accelerator backend (per-request dispatch would be latency-bound).
+Fixed batch buckets avoid per-size recompilation under jit.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("x", "event", "result", "error")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[str] = None
+
+
+class InferenceServer:
+    """Serve ``model.output`` over HTTP (parity: DL4jServeRouteBuilder)."""
+
+    def __init__(self, model, port: int = 0, *, max_batch: int = 64,
+                 batch_timeout_ms: float = 5.0,
+                 pad_to_buckets: bool = True):
+        self._model = model
+        self.max_batch = int(max_batch)
+        self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
+        self.pad_to_buckets = pad_to_buckets
+        self.served = 0
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._batcher = threading.Thread(target=self._batch_loop, daemon=True)
+        self._batcher.start()
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json({"ok": True,
+                                "model": type(outer._model).__name__,
+                                "served": outer.served})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(length).decode())
+                except Exception as e:
+                    self._json({"error": f"bad request: {e}"}, 400)
+                    return
+                if self.path == "/predict":
+                    try:
+                        x = np.asarray(payload["inputs"], dtype=np.float32)
+                    except Exception as e:
+                        self._json({"error": f"bad inputs: {e}"}, 400)
+                        return
+                    out, err = outer._predict(x)
+                    if err is not None:
+                        self._json({"error": err}, 500)
+                    else:
+                        self._json({"outputs": out.tolist()})
+                elif self.path == "/model":
+                    try:
+                        outer.swap_model_from(payload["path"])
+                        self._json({"ok": True})
+                    except Exception as e:
+                        self._json({"error": str(e)}, 400)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)), Handler)
+        self.port = self._httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._serve_thread.start()
+
+    # ------------------------------------------------------------------
+
+    def _predict(self, x: np.ndarray):
+        p = _Pending(x)
+        self._queue.put(p)
+        p.event.wait(timeout=60.0)
+        if p.error is not None:
+            return None, p.error
+        if p.result is None:
+            return None, "inference timeout"
+        return p.result, None
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            batch = [first]
+            n = first.x.shape[0]
+            deadline = time.perf_counter() + self.batch_timeout_s
+            while n < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    p = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(p)
+                n += p.x.shape[0]
+            self._run_batch(batch)
+
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, max(self.max_batch, n))
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        try:
+            x = np.concatenate([p.x for p in batch], axis=0)
+            n = x.shape[0]
+            if self.pad_to_buckets:
+                b = self._bucket(n)
+                if b > n:  # pad to a power-of-two bucket: one jit cache
+                    x = np.concatenate(
+                        [x, np.zeros((b - n,) + x.shape[1:], x.dtype)])
+            with self._lock:
+                out = np.asarray(self._model.output(x))[:n]
+            ofs = 0
+            for p in batch:
+                k = p.x.shape[0]
+                p.result = out[ofs:ofs + k]
+                ofs += k
+                p.event.set()
+            self.served += n
+        except Exception as e:
+            for p in batch:
+                p.error = f"{type(e).__name__}: {e}"
+                p.event.set()
+
+    # ------------------------------------------------------------------
+
+    def set_model(self, model) -> None:
+        """Hot-swap the served model (atomic w.r.t. in-flight batches)."""
+        with self._lock:
+            self._model = model
+
+    def swap_model_from(self, path: str) -> None:
+        """Load a checkpoint zip (util.serialization) and serve it."""
+        from ..util.serialization import load_model
+        self.set_model(load_model(path))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()

@@ -6,9 +6,11 @@ notifications), ``StatsStorageRouter.java``, ``impl/CollectionStatsStorageRouter
 impls ``InMemoryStatsStorage`` and the MapDB-backed store (here: JSONL file).
 """
 
+from .remote import RemoteUIStatsStorageRouter
 from .stats_storage import (FileStatsStorage, InMemoryStatsStorage,
                             Persistable, StatsStorage, StatsStorageListener,
                             StatsStorageRouter)
 
 __all__ = ["StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
-           "Persistable", "StatsStorageRouter", "StatsStorageListener"]
+           "Persistable", "StatsStorageRouter", "StatsStorageListener",
+           "RemoteUIStatsStorageRouter"]

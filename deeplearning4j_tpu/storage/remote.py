@@ -1,0 +1,112 @@
+"""Remote stats routing: POST training stats to a UI server on another host.
+
+Parity: reference ``deeplearning4j-core/.../api/storage/impl/
+RemoteUIStatsStorageRouter.java`` — workers/Spark executors route their
+``Persistable`` stats records over HTTP to the central UI's
+``RemoteReceiverModule``. Here the receiver is the UI server's
+``POST /api/remote`` endpoint (:mod:`deeplearning4j_tpu.ui.server`).
+
+Async by design (like the reference): a daemon thread drains a bounded
+queue so a slow/unreachable UI never blocks the training loop; after
+``max_retries`` consecutive failures records are dropped with a warning
+(the reference behaves the same — stats are best-effort telemetry).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.request
+import warnings
+from typing import Optional
+
+from .stats_storage import Persistable, StatsStorageRouter
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """Routes records to ``<url>/api/remote`` via HTTP POST."""
+
+    _SENTINEL = object()
+
+    def __init__(self, url: str, *, queue_size: int = 1000,
+                 max_retries: int = 3, timeout: float = 5.0):
+        self.url = url.rstrip("/") + "/api/remote"
+        self.max_retries = int(max_retries)
+        self.timeout = float(timeout)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._dropped = 0
+        self._posted = 0
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    # -- router interface --
+
+    def put_static_info(self, record: Persistable) -> None:
+        self._enqueue("static", record)
+
+    def put_update(self, record: Persistable) -> None:
+        self._enqueue("update", record)
+
+    # -- internals --
+
+    def _enqueue(self, kind: str, record: Persistable) -> None:
+        if self._closed:
+            raise ValueError("router is closed")
+        try:
+            self._queue.put_nowait((kind, record))
+        except queue.Full:
+            self._dropped += 1
+
+    def _post(self, kind: str, record: Persistable) -> bool:
+        body = json.dumps({"kind": kind,
+                           "record": json.loads(record.to_json())}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        for _ in range(self.max_retries):
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    if 200 <= r.status < 300:
+                        return True
+            except Exception:
+                pass
+        return False
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is self._SENTINEL:
+                    return
+                kind, record = item
+                if self._post(kind, record):
+                    self._posted += 1
+                else:
+                    self._dropped += 1
+            finally:
+                # task_done AFTER the POST so flush() waits for in-flight
+                # records, not just an empty queue.
+                self._queue.task_done()
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Block until queued records are posted (or timeout)."""
+        import time
+        q = self._queue
+        deadline = time.time() + timeout
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                remaining = deadline - time.time()
+                if remaining <= 0 or not q.all_tasks_done.wait(remaining):
+                    break
+
+    def close(self, timeout: float = 10.0) -> None:
+        self.flush(timeout)
+        self._closed = True
+        self._queue.put(self._SENTINEL)
+        self._thread.join(timeout=timeout)
+        if self._dropped:
+            warnings.warn(
+                f"RemoteUIStatsStorageRouter dropped {self._dropped} records "
+                f"(posted {self._posted})")

@@ -7,10 +7,15 @@ dependency-free stdlib HTTP server: JSON endpoints + one self-contained HTML
 page with inline SVG charts.
 
 Endpoints:
-  GET /                    dashboard page
-  GET /api/sessions        session ids
-  GET /api/overview?sid=   score series + timing + memory
-  GET /api/static?sid=     model/static info
+  GET  /                    dashboard page
+  GET  /api/sessions        session ids
+  GET  /api/overview?sid=   score series + timing + memory
+  GET  /api/static?sid=     model/static info
+  GET  /api/histograms?sid= latest param/update histograms + norm series
+                            (parity: HistogramModule)
+  POST /api/remote          receive stats records POSTed by
+                            RemoteUIStatsStorageRouter from other hosts
+                            (parity: RemoteReceiverModule)
 """
 
 from __future__ import annotations
@@ -35,6 +40,10 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><b>Session:</b> <select id="sid"></select></div>
 <div class="card"><b>Score vs iteration</b><svg id="score"></svg></div>
 <div class="card"><b>Iteration time (ms)</b><svg id="timing"></svg></div>
+<div class="card"><b>Parameter histograms</b> (latest snapshot)
+ <div id="hists"></div></div>
+<div class="card"><b>Update:param ratio (log10)</b><svg id="ratios"></svg>
+ <div id="ratio_legend" style="font-size:11px"></div></div>
 <div class="card"><b>Model</b><pre id="model"></pre></div>
 <script>
 async function j(u){return (await fetch(u)).json()}
@@ -51,12 +60,69 @@ function line(svg, xs, ys, color){
    <text x="5" y="15" font-size="11">${ymax.toPrecision(4)}</text>
    <text x="5" y="${H-8}" font-size="11">${ymin.toPrecision(4)}</text>`;
 }
+function multiline(svgId, series, legendId){
+  const el=document.getElementById(svgId); el.innerHTML='';
+  const names=Object.keys(series); if(!names.length) return;
+  const W=900,H=260,P=35;
+  const colors=['#1565c0','#e65100','#2e7d32','#c62828','#6a1b9a',
+                '#00838f','#f9a825','#4e342e'];
+  let xmin=1e99,xmax=-1e99,ymin=1e99,ymax=-1e99;
+  for(const n of names){
+    for(const x of series[n].xs){xmin=Math.min(xmin,x);xmax=Math.max(xmax,x)}
+    for(const y of series[n].ys){ymin=Math.min(ymin,y);ymax=Math.max(ymax,y)}
+  }
+  const sx=x=>P+(x-xmin)/(xmax-xmin||1)*(W-2*P);
+  const sy=y=>H-P-(y-ymin)/(ymax-ymin||1)*(H-2*P);
+  let out='',leg='';
+  names.forEach((n,i)=>{
+    const c=colors[i%colors.length], s=series[n];
+    out+=`<path d="M${s.xs.map((x,k)=>sx(x)+','+sy(s.ys[k])).join(' L')}"
+      fill="none" stroke="${c}" stroke-width="1.2"/>`;
+    leg+=`<span style="color:${c}">&#9632; ${n}</span> `;
+  });
+  out+=`<text x="5" y="15" font-size="11">${ymax.toPrecision(3)}</text>
+   <text x="5" y="${H-8}" font-size="11">${ymin.toPrecision(3)}</text>`;
+  el.innerHTML=out;
+  if(legendId) document.getElementById(legendId).innerHTML=leg;
+}
+function histSvg(h, color){
+  const W=280,H=90,n=h.counts.length;
+  const m=Math.max(...h.counts)||1;
+  let rects='';
+  h.counts.forEach((c,i)=>{
+    const bh=(c/m)*(H-18);
+    rects+=`<rect x="${i*W/n}" y="${H-16-bh}" width="${W/n-1}"
+      height="${bh}" fill="${color}"/>`;
+  });
+  return `<svg style="width:${W}px;height:${H}px">${rects}
+    <text x="0" y="${H-3}" font-size="9">${h.min.toPrecision(3)}</text>
+    <text x="${W-55}" y="${H-3}" font-size="9">${h.max.toPrecision(3)}</text>
+  </svg>`;
+}
 async function refresh(){
   const sid=document.getElementById('sid').value;
   if(!sid) return;
   const o=await j('/api/overview?sid='+sid);
   line('score', o.iterations, o.scores, '#1565c0');
   line('timing', o.iterations.slice(1), o.timings.slice(1), '#e65100');
+  const hg=await j('/api/histograms?sid='+sid);
+  const hd=document.getElementById('hists'); hd.innerHTML='';
+  if(hg.latest.parameters){
+    for(const [name,entry] of Object.entries(hg.latest.parameters)){
+      let cell=`<div style="display:inline-block;margin:4px;
+        vertical-align:top"><div style="font-size:11px">${name}
+        &nbsp;|W|=${entry.norm.toPrecision(3)}</div>`;
+      cell+=histSvg(entry.histogram,'#1565c0');
+      if(entry.update) cell+=histSvg(entry.update.histogram,'#e65100');
+      hd.innerHTML+=cell+'</div>';
+    }
+  }
+  const series={};
+  for(const [name,s] of Object.entries(hg.norm_series)){
+    const ys=s.update_ratios.map(r=>r>0?Math.log10(r):-10);
+    if(s.iterations.length>1) series[name]={xs:s.iterations, ys:ys};
+  }
+  multiline('ratios', series, 'ratio_legend');
   const s=await j('/api/static?sid='+sid);
   document.getElementById('model').textContent=JSON.stringify(s,null,1);
 }
@@ -117,8 +183,59 @@ class _Handler(BaseHTTPRequestHandler):
                     out[wid] = {k: v for k, v in rec.data.items()
                                 if k != "config_json"}
             self._json(out)
+        elif url.path == "/api/histograms":
+            # latest param histograms + per-param norm time series
+            # (parity: the reference HistogramModule's data feed)
+            sid = q.get("sid", [""])[0]
+            workers = st.list_workers(sid, "StatsListener")
+            latest, norms = {}, {}
+            for wid in workers:
+                for rec in st.get_all_updates_after(sid, "StatsListener",
+                                                    wid, 0.0):
+                    params = rec.data.get("parameters")
+                    if not params:
+                        continue
+                    it = rec.data.get("iteration")
+                    # newest snapshot across ALL workers, by iteration —
+                    # not whichever worker happens to iterate last
+                    if it is not None and it >= latest.get("iteration", -1):
+                        latest = {"iteration": it, "worker": wid,
+                                  "parameters": params}
+                    for pname, entry in params.items():
+                        # one series per (param, worker) so multi-worker
+                        # sessions don't interleave into a zig-zag
+                        key = (pname if len(workers) == 1
+                               else f"{pname} [{wid}]")
+                        s = norms.setdefault(key, {"iterations": [],
+                                                   "norms": [],
+                                                   "update_ratios": []})
+                        s["iterations"].append(it)
+                        s["norms"].append(entry.get("norm"))
+                        s["update_ratios"].append(entry.get("update_ratio"))
+            self._json({"latest": latest, "norm_series": norms})
         else:
             self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        if url.path != "/api/remote":
+            self._json({"error": "not found"}, 404)
+            return
+        # receiver for RemoteUIStatsStorageRouter (parity:
+        # RemoteReceiverModule) — remote/distributed runs report into the
+        # attached storage exactly like local listeners
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length).decode())
+            from ..storage.stats_storage import Persistable
+            rec = Persistable.from_json(json.dumps(payload["record"]))
+            if payload.get("kind") == "static":
+                self.storage.put_static_info(rec)
+            else:
+                self.storage.put_update(rec)
+            self._json({"ok": True})
+        except Exception as e:  # malformed POSTs must not kill the server
+            self._json({"error": str(e)}, 400)
 
 
 class UIServer:

@@ -1,0 +1,83 @@
+"""Worker for the two-process distributed test (the analog of the
+reference's Spark ``local[n]`` trick, ``BaseSparkTest.java:90`` — but with a
+REAL process boundary: two OS processes joined via jax.distributed, 4
+virtual CPU devices each, one 8-device global mesh).
+
+Invoked by tests/test_distributed.py as:
+    python _two_process_worker.py <coordinator_port> <rank> <n_steps>
+
+Prints one line: ``RESULT <rank> <json>`` with per-step losses and a
+parameter checksum (must match across ranks AND match single-process).
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU plugin preloads jax at interpreter startup; env vars are too
+# late, the config API still works (same dance as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    port, rank, n_steps = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from deeplearning4j_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator_address=f"localhost:{port}",
+                    num_processes=2, process_id=rank)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.training_master import SyncTrainingMaster
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater("nesterovs").momentum(0.9).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    mesh = dist.global_mesh()
+    assert mesh.shape["data"] == 8
+    trainer = SyncTrainingMaster().build(net, mesh)
+
+    rng = np.random.default_rng(123)
+    losses = []
+    for _ in range(n_steps):
+        # every process generates the same GLOBAL batch, then feeds only its
+        # process-local half through make_array_from_process_local_data
+        xg = rng.normal(size=(32, 8)).astype(np.float32)
+        yg = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        lo, hi = rank * 16, (rank + 1) * 16
+        x, y = dist.host_local_batch(mesh, xg[lo:hi], yg[lo:hi])
+        loss = trainer.fit_batch(x, y)
+        losses.append(float(loss))
+
+    checksum = float(sum(
+        np.abs(np.asarray(l)).sum()
+        for l in jax.tree_util.tree_leaves(net.params)))
+    print("RESULT", rank, json.dumps({"losses": losses,
+                                      "checksum": checksum}), flush=True)
+    dist.shutdown()
+
+
+if __name__ == "__main__":
+    main()

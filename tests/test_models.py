@@ -1,5 +1,6 @@
 """Model zoo tests: configs build, shapes infer, small variants train."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -69,3 +70,48 @@ class TestCharRnn:
         step1 = net.rnn_time_step(np.eye(8, dtype=np.float32)[[0, 1]])
         step2 = net.rnn_time_step(np.eye(8, dtype=np.float32)[[2, 3]])
         assert step1.shape == (2, 8) and step2.shape == (2, 8)
+
+
+class TestSpaceToDepthStem:
+    def test_s2d_layer_shapes_and_values(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import SpaceToDepthLayer
+        x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+        layer = SpaceToDepthLayer(block_size=2)
+        out, _ = layer.apply({}, jnp.asarray(x))
+        assert out.shape == (2, 4, 4, 12)
+        # channel order (di, dj, c): out[.., di*2c_ + dj*c + c_i]
+        assert np.allclose(np.asarray(out)[0, 1, 2, 0:3], x[0, 2, 4, :])
+        assert np.allclose(np.asarray(out)[0, 1, 2, 3:6], x[0, 2, 5, :])
+        assert np.allclose(np.asarray(out)[0, 1, 2, 6:9], x[0, 3, 4, :])
+        assert np.allclose(np.asarray(out)[0, 1, 2, 9:12], x[0, 3, 5, :])
+
+    def test_stem_lowering_exact_equivalence(self, rng):
+        """7x7/2 SAME conv == s2d(2x2) + 4x4/1 SAME conv with folded weights
+        (the MXU stem lowering must be EXACT, not approximate)."""
+        from deeplearning4j_tpu.models.resnet import fold_stem_7x7_to_s2d
+        from deeplearning4j_tpu.nn.conf.layers import SpaceToDepthLayer
+        from deeplearning4j_tpu.ops import convops
+
+        x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+        w7 = rng.normal(size=(7, 7, 3, 16)).astype(np.float32)
+        ref = convops.conv2d(jnp.asarray(x), jnp.asarray(w7),
+                             stride=(2, 2), padding="same")
+        s2d, _ = SpaceToDepthLayer(block_size=2).apply({}, jnp.asarray(x))
+        w4 = fold_stem_7x7_to_s2d(w7)
+        out = convops.conv2d(s2d, jnp.asarray(w4), stride=(1, 1),
+                             padding="same")
+        assert out.shape == ref.shape == (2, 16, 16, 16)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4), \
+            np.abs(np.asarray(out) - np.asarray(ref)).max()
+
+    def test_resnet_s2d_stem_builds_and_trains(self, rng):
+        from deeplearning4j_tpu.models.resnet import resnet
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = resnet((1, 1), height=32, width=32, width_base=8,
+                      n_classes=4, dtype="float32", stem="space_to_depth")
+        net = ComputationGraph(conf).init()
+        x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+        loss0 = net.fit_batch([x], [y])
+        loss1 = net.fit_batch([x], [y])
+        assert np.isfinite(loss1) and float(loss1) < float(loss0) * 1.5

@@ -193,3 +193,51 @@ class TestGlove:
     def test_empty_raises(self):
         with pytest.raises(ValueError):
             Glove(layer_size=8).fit([[]])
+
+
+class TestDistributedEmbeddings:
+    """Data-parallel word2vec (parity role: dl4j-spark-nlp Word2Vec —
+    here per-step exact grad all-reduce over the mesh, VERDICT r3 row 32)."""
+
+    def test_sharded_step_matches_single_device(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp import learning
+        from deeplearning4j_tpu.parallel import data_parallel_mesh
+
+        V, D, B, K = 50, 16, 32, 5
+        mesh = data_parallel_mesh(8)
+        params = learning.init_params(V, D, seed=1)
+        params2 = learning.init_params(V, D, seed=1)
+        center = rng.integers(0, V, B).astype(np.int32)
+        target = rng.integers(0, V, B).astype(np.int32)
+        negs = rng.integers(0, V, (B, K)).astype(np.int32)
+        ctx = np.zeros((B, 1), np.int32)
+        cm = np.ones((B, 1), np.float32)
+        lr = jnp.float32(0.025)
+        ref, ref_loss = learning.ns_step(
+            params, jnp.asarray(center), jnp.asarray(target),
+            jnp.asarray(negs), jnp.asarray(ctx), jnp.asarray(cm), lr)
+        step = learning.make_sharded_ns_step(mesh)
+        out, loss = step(params2, jnp.asarray(center), jnp.asarray(target),
+                         jnp.asarray(negs), jnp.asarray(ctx),
+                         jnp.asarray(cm), lr)
+        assert np.isclose(float(loss), float(ref_loss), atol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(out)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_word2vec_trains_on_mesh(self):
+        from deeplearning4j_tpu.nlp import Word2Vec
+        from deeplearning4j_tpu.parallel import data_parallel_mesh
+
+        corpus = ["the quick brown fox jumps over the lazy dog",
+                  "the quick red fox leaps over the sleepy cat"] * 40
+        w2v = Word2Vec(corpus, layer_size=16, window=2, negative=3,
+                       epochs=2, batch_size=64, seed=7,
+                       mesh=data_parallel_mesh(8))
+        w2v.fit(corpus)
+        assert w2v.has_word("fox")
+        sim = w2v.similarity("fox", "fox")
+        assert np.isclose(sim, 1.0, atol=1e-5)
+        assert np.isfinite(w2v.similarity("quick", "lazy"))

@@ -269,3 +269,61 @@ class TestGraphParallel:
         pw = ParallelWrapper(net, mesh=data_parallel_mesh(8))
         pw.fit(ArrayDataSetIterator(x, y, 32), epochs=2)
         assert net.iteration_count == 6
+
+
+class TestPhaseStats:
+    """Phase-timing stats (parity: SparkTrainingStats / StatsUtils
+    exportStatsAsHtml, reference dl4j-spark stats/)."""
+
+    def test_sync_master_collects_phases(self, rng, tmp_path):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.parallel.training_master import (
+            SyncTrainingMaster)
+        x, y = _data(rng)
+        net = MultiLayerNetwork(_conf()).init()
+        master = SyncTrainingMaster(collect_stats=True, blocking_stats=True)
+        trainer = master.build(net)
+        it = ListDataSetIterator(
+            [DataSet(x[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)])
+        trainer.fit(it, epochs=2)
+        s = trainer.stats()
+        assert s is not None
+        assert set(s) >= {"batch_prep", "step"}
+        assert s["step"]["count"] == 8
+        assert s["step"]["total_ms"] > 0
+        for k in ("count", "total_ms", "mean_ms", "min_ms", "max_ms"):
+            assert k in s["step"]
+        # HTML timeline export (parity: StatsUtils.java:69-92)
+        out = tmp_path / "timeline.html"
+        trainer.export_stats_html(str(out))
+        body = out.read_text()
+        assert "svg" in body and "step" in body
+
+    def test_paramavg_master_collects_average_phase(self, rng):
+        from deeplearning4j_tpu.parallel.training_master import (
+            ParameterAveragingTrainingMaster)
+        x, y = _data(rng)
+        net = MultiLayerNetwork(_conf()).init()
+        master = ParameterAveragingTrainingMaster(
+            averaging_frequency=2, collect_stats=True)
+        trainer = master.build(net)
+        for i in range(4):
+            trainer.fit_batch(x[:32], y[:32])
+        trainer.finish()
+        s = trainer.stats()
+        assert s["step"]["count"] == 4
+        assert s["average"]["count"] >= 2
+        assert "sync_to_net" in s
+        js = trainer.training_stats().as_json()
+        import json as _json
+        parsed = _json.loads(js)
+        assert parsed["summary"]["step"]["count"] == 4
+        assert len(parsed["events"]) >= 8
+
+    def test_stats_off_by_default(self, rng):
+        from deeplearning4j_tpu.parallel.training_master import (
+            SyncTrainingMaster)
+        net = MultiLayerNetwork(_conf()).init()
+        trainer = SyncTrainingMaster().build(net)
+        assert trainer.stats() is None

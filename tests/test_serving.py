@@ -1,0 +1,109 @@
+"""Inference-server tests (parity model: the reference's dl4j-streaming
+serve route — records in, predictions out, model swap — minus the Kafka
+brokers, per SCOPE.md)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import InferenceServer
+
+
+def _net(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater("sgd")
+            .learning_rate(0.1).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+class TestInferenceServer:
+    def test_predict_matches_direct_output(self, rng):
+        net = _net()
+        server = InferenceServer(net, port=0)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            x = rng.normal(size=(4, 5)).astype(np.float32)
+            out = _post(base, "/predict", {"inputs": x.tolist()})["outputs"]
+            ref = np.asarray(net.output(x))
+            assert np.allclose(np.asarray(out), ref, atol=1e-5)
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=5).read())
+            assert health["ok"] and health["served"] == 4
+        finally:
+            server.stop()
+
+    def test_concurrent_requests_microbatched(self, rng):
+        net = _net()
+        server = InferenceServer(net, port=0, max_batch=32,
+                                 batch_timeout_ms=20.0)
+        base = f"http://127.0.0.1:{server.port}"
+        xs = [rng.normal(size=(2, 5)).astype(np.float32) for _ in range(8)]
+        results = [None] * 8
+
+        def call(i):
+            results[i] = _post(base, "/predict",
+                               {"inputs": xs[i].tolist()})["outputs"]
+        try:
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            for i in range(8):
+                ref = np.asarray(net.output(xs[i]))
+                assert np.allclose(np.asarray(results[i]), ref, atol=1e-5), i
+        finally:
+            server.stop()
+
+    def test_hot_model_swap(self, rng, tmp_path):
+        from deeplearning4j_tpu.util.serialization import save_model
+        net1, net2 = _net(seed=1), _net(seed=99)
+        p = str(tmp_path / "m2.zip")
+        save_model(net2, p)
+        server = InferenceServer(net1, port=0)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            x = rng.normal(size=(3, 5)).astype(np.float32)
+            before = _post(base, "/predict", {"inputs": x.tolist()})["outputs"]
+            assert _post(base, "/model", {"path": p})["ok"]
+            after = _post(base, "/predict", {"inputs": x.tolist()})["outputs"]
+            assert np.allclose(np.asarray(after),
+                               np.asarray(net2.output(x)), atol=1e-5)
+            assert not np.allclose(np.asarray(before), np.asarray(after))
+        finally:
+            server.stop()
+
+    def test_bad_request_does_not_kill_server(self):
+        net = _net()
+        server = InferenceServer(net, port=0)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            req = urllib.request.Request(base + "/predict", data=b"nope",
+                                         method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                assert False
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=5).read())
+            assert health["ok"]
+        finally:
+            server.stop()

@@ -1,0 +1,133 @@
+"""SpTree/QuadTree + Barnes-Hut t-SNE tests (parity model: reference
+SpTree/QuadTree tests + BarnesHutTsne correctness; the BH gradient is
+validated against the exact O(N²) repulsion at tight theta)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import native
+from deeplearning4j_tpu.clustering.sptree import QuadTree, SpTree
+
+
+def _exact_repulsion(y, i):
+    """Exact (theta→0) repulsive force + sum_q contribution for point i."""
+    diff = y[i] - y
+    d2 = np.sum(diff * diff, axis=1)
+    q = 1.0 / (1.0 + d2)
+    q[i] = 0.0
+    neg = np.sum((q * q)[:, None] * diff, axis=0)
+    return neg, float(np.sum(q))
+
+
+class TestSpTree:
+    def test_structure_and_mass(self, rng):
+        pts = rng.normal(size=(200, 2))
+        tree = SpTree(pts)
+        assert tree.is_correct()
+        assert tree._count[0] == 200
+        assert np.allclose(tree._com[0], pts.mean(axis=0), atol=1e-9)
+
+    def test_3d(self, rng):
+        pts = rng.normal(size=(100, 3))
+        tree = SpTree(pts)
+        assert tree.is_correct()
+        assert tree.n_children == 8
+        assert tree._count[0] == 100
+
+    def test_duplicate_points(self):
+        pts = np.array([[0.0, 0.0], [0.0, 0.0], [1.0, 1.0]])
+        tree = SpTree(pts)
+        assert tree._count[0] == 3  # duplicates carry mass
+
+    def test_quadtree_requires_2d(self, rng):
+        with pytest.raises(ValueError):
+            QuadTree(rng.normal(size=(10, 3)))
+        assert QuadTree(rng.normal(size=(10, 2))).is_correct()
+
+    def test_small_theta_matches_exact(self, rng):
+        """theta→0 forces every cell to open: BH must equal O(N²) exactly."""
+        y = rng.normal(size=(80, 2))
+        tree = SpTree(y)
+        for i in (0, 17, 79):
+            neg, sq = tree.compute_non_edge_forces(i, theta=1e-6)
+            ref_neg, ref_sq = _exact_repulsion(y, i)
+            assert np.allclose(neg, ref_neg, atol=1e-9)
+            assert np.isclose(sq, ref_sq, atol=1e-9)
+
+    def test_moderate_theta_approximates(self, rng):
+        y = rng.normal(size=(300, 2))
+        tree = SpTree(y)
+        neg, sq = tree.compute_non_edge_forces(5, theta=0.5)
+        ref_neg, ref_sq = _exact_repulsion(y, 5)
+        assert np.isclose(sq, ref_sq, rtol=0.05)
+        assert np.allclose(neg, ref_neg,
+                           atol=0.05 * np.linalg.norm(ref_neg) + 1e-9)
+
+
+@pytest.mark.skipif(native.load() is None,
+                    reason="no C++ toolchain for the native SpTree kernel")
+class TestNativeKernel:
+    def test_native_matches_python_tree(self, rng):
+        y = rng.normal(size=(150, 2))
+        tree = SpTree(y)
+        for i in (0, 42, 149):
+            py_neg, py_sq = tree.compute_non_edge_forces(i, theta=0.5)
+            c_neg, c_sq = native.non_edge_forces(y, i, 0.5)
+            assert np.allclose(c_neg, py_neg, atol=1e-9)
+            assert np.isclose(c_sq, py_sq, atol=1e-9)
+
+    def test_native_gradient_matches_python(self, rng):
+        from deeplearning4j_tpu.plot.tsne import (_bh_gradient_python,
+                                                  _knn_sparse_p)
+        x = rng.normal(size=(120, 5))
+        row_ptr, cols, vals = _knn_sparse_p(x, perplexity=10.0, k=30)
+        y = np.ascontiguousarray(rng.normal(size=(120, 2)))
+        c_grad, c_kl = native.bh_gradient(y, row_ptr, cols, vals, 0.5)
+        p_grad, p_kl = _bh_gradient_python(y, row_ptr, cols, vals, 0.5)
+        assert np.allclose(c_grad, p_grad, atol=1e-9)
+        assert np.isclose(c_kl, p_kl, atol=1e-9)
+
+
+class TestBarnesHutTsne:
+    def test_sparse_p_sums_to_one(self, rng):
+        from deeplearning4j_tpu.plot.tsne import _knn_sparse_p
+        x = rng.normal(size=(100, 8))
+        row_ptr, cols, vals = _knn_sparse_p(x, perplexity=15.0, k=45)
+        assert np.isclose(vals.sum(), 1.0, atol=1e-6)
+        assert row_ptr[-1] == len(cols) == len(vals)
+        # symmetric: (i,j) and (j,i) both present with equal value
+        edges = {(int(r), int(c)): v for r, c, v in
+                 zip(np.repeat(np.arange(100), np.diff(row_ptr)), cols, vals)}
+        for (i, j), v in list(edges.items())[:50]:
+            assert np.isclose(edges[(j, i)], v)
+
+    def test_bh_separates_clusters(self, rng):
+        """End-to-end: 3 well-separated gaussian clusters stay separated in
+        the BH embedding (theta actually used — n above dense_threshold)."""
+        from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+        n_per = 80
+        centers = np.array([[0, 0, 0, 0], [12, 0, 0, 0], [0, 12, 0, 0]],
+                           dtype=np.float64)
+        x = np.concatenate([
+            rng.normal(size=(n_per, 4)) * 0.5 + c for c in centers])
+        ts = BarnesHutTsne(theta=0.5, dense_threshold=10, perplexity=20.0,
+                           max_iter=150, seed=3)
+        emb = ts.fit_transform(x)
+        assert emb.shape == (3 * n_per, 2)
+        assert ts.kl_divergence is not None and np.isfinite(ts.kl_divergence)
+        labels = np.repeat(np.arange(3), n_per)
+        cents = np.stack([emb[labels == c].mean(axis=0) for c in range(3)])
+        spread = max(np.linalg.norm(emb[labels == c] - cents[c], axis=1).mean()
+                     for c in range(3))
+        min_gap = min(np.linalg.norm(cents[a] - cents[b])
+                      for a in range(3) for b in range(a + 1, 3))
+        assert min_gap > 2.0 * spread, (min_gap, spread)
+
+    def test_theta_zero_uses_dense_path(self, rng):
+        from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
+        x = rng.normal(size=(120, 6)).astype(np.float32)
+        bh = BarnesHutTsne(theta=0.0, perplexity=10.0, max_iter=50, seed=1)
+        dn = Tsne(perplexity=10.0, max_iter=50, seed=1)
+        a = bh.fit_transform(x)
+        b = dn.fit_transform(x)
+        assert np.allclose(a, b, atol=1e-4)
