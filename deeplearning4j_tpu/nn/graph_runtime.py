@@ -14,6 +14,7 @@ runtime analog — vertex boundaries disappear into XLA fusion.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -24,6 +25,7 @@ from .. import dtypes as _dtypes
 from .. import losses as _losses
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
+from ..util import xla as _xla
 from .conf.graph import ComputationGraphConfiguration, LayerVertex
 
 Pytree = Any
@@ -313,7 +315,8 @@ class ComputationGraph:
             params = _updaters.apply_updates(params, deltas)
             return params, opt_state, new_states, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1),
+                       compiler_options=_xla.train_step_options())
 
     def _train_step(self):
         fn = self._jit_cache.get("train_step")
@@ -343,10 +346,15 @@ class ComputationGraph:
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
+        base = _rng.key(t.seed)
 
         def one(carry, batch):
             params, opt_state, states, it = carry
-            xs, ys, masks, rng = batch
+            xs, ys, masks = batch
+            # per-step rng derived from the TRACED counter — computing keys
+            # eagerly from the host-side update count bakes fresh constants
+            # into the program and forces a recompile every call
+            rng = jax.random.fold_in(base, it)
             (loss, new_states), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(
                     params, states, xs, ys, masks, rng)
@@ -358,12 +366,14 @@ class ComputationGraph:
                     for name, st_old in states.items()}
             return (params, opt_state, kept, it + 1), loss
 
-        def scan_steps(params, opt_state, states, xs, ys, masks, rngs, it0):
+        def scan_steps(params, opt_state, states, xs, ys, masks, it0):
             (params, opt_state, states, _), losses = jax.lax.scan(
-                one, (params, opt_state, states, it0), (xs, ys, masks, rngs))
+                one, (params, opt_state, states, it0), (xs, ys, masks),
+                unroll=_xla.scan_unroll())
             return params, opt_state, states, losses
 
-        return jax.jit(scan_steps, donate_argnums=(0, 1))
+        return jax.jit(scan_steps, donate_argnums=(0, 1),
+                       compiler_options=_xla.train_step_options())
 
     def fit_scan(self, xs, ys, masks=None):
         """Train on K pre-staged batches in one dispatch. xs/ys: [k, b, ...]
@@ -378,13 +388,10 @@ class ComputationGraph:
         if fn is None:
             fn = self._make_train_scan()
             self._jit_cache["train_scan"] = fn
-        base = _rng.key(self.training.seed)
-        rngs = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(self._update_count, self._update_count + k))
         it0 = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, losses = fn(
             self.params, self.updater_state, self._states_map(), xs, ys,
-            masks, rngs, it0)
+            masks, it0)
         self.params = params
         self.updater_state = opt_state
         self._update_count += k
@@ -425,16 +432,18 @@ class ComputationGraph:
             return (params, opt_state, kept), loss
 
         def repeat_steps(params, opt_state, states, xs, ys, masks, it0, k):
-            # unroll=2: XLA removes inter-iteration carry copies between the
-            # paired bodies (measured ~1.2 ms/step on ResNet-50 @ v5e)
+            # unroll (default 2): XLA removes inter-iteration carry copies
+            # between the paired bodies (measured ~1.2 ms/step on ResNet-50
+            # @ v5e); DL4JTPU_SCAN_UNROLL overrides for tuning
             (params, opt_state, states), losses = jax.lax.scan(
                 functools.partial(one, xs, ys, masks),
                 (params, opt_state, states), it0 + jnp.arange(k),
-                unroll=2)
+                unroll=_xla.scan_unroll())
             return params, opt_state, states, losses
 
         return jax.jit(repeat_steps, donate_argnums=(0, 1, 2),
-                       static_argnums=(7,))
+                       static_argnums=(7,),
+                       compiler_options=_xla.train_step_options())
 
     def fit_repeated(self, inputs, labels, k: int, masks=None):
         """Run K optimizer updates on one pre-staged batch in a single device

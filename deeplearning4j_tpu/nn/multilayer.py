@@ -29,6 +29,7 @@ TPU-native design (NOT a port):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -39,6 +40,7 @@ from .. import dtypes as _dtypes
 from .. import losses as _losses
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
+from ..util import xla as _xla
 from .conf.multi_layer import MultiLayerConfiguration
 
 Pytree = Any
@@ -327,7 +329,8 @@ class MultiLayerNetwork:
             params = _updaters.apply_updates(params, deltas)
             return params, opt_state, new_states, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1),
+                       compiler_options=_xla.train_step_options())
 
     def _train_step(self):
         fn = self._jit_cache.get("train_step")
@@ -344,10 +347,15 @@ class MultiLayerNetwork:
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
+        base = _rng.key(t.seed)
 
         def one(carry, batch):
             params, opt_state, states, it = carry
-            x, y, mask, rng = batch
+            x, y, mask = batch
+            # per-step rng derived from the TRACED counter — computing keys
+            # eagerly from the host-side update count bakes fresh constants
+            # into the program and forces a recompile every call
+            rng = jax.random.fold_in(base, it)
             (loss, new_states), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
             grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
@@ -360,12 +368,14 @@ class MultiLayerNetwork:
                 for i, st_old in enumerate(states)]
             return (params, opt_state, kept, it + 1), loss
 
-        def scan_steps(params, opt_state, states, xs, ys, masks, rngs, it0):
+        def scan_steps(params, opt_state, states, xs, ys, masks, it0):
             (params, opt_state, states, _), losses = jax.lax.scan(
-                one, (params, opt_state, states, it0), (xs, ys, masks, rngs))
+                one, (params, opt_state, states, it0), (xs, ys, masks),
+                unroll=_xla.scan_unroll())
             return params, opt_state, states, losses
 
-        return jax.jit(scan_steps, donate_argnums=(0, 1))
+        return jax.jit(scan_steps, donate_argnums=(0, 1),
+                       compiler_options=_xla.train_step_options())
 
     def fit_scan(self, xs, ys, masks=None):
         """Train on K pre-staged batches in one device dispatch.
@@ -381,14 +391,10 @@ class MultiLayerNetwork:
         if fn is None:
             fn = self._make_train_scan()
             self._jit_cache["train_scan"] = fn
-        base = _rng.key(self.training.seed)
-        rngs = jax.vmap(
-            lambda i: jax.random.fold_in(base, i))(
-                jnp.arange(self._update_count, self._update_count + k))
         it0 = jnp.asarray(self._update_count, jnp.int32)
         states = self._states_list()
         params, opt_state, new_states, losses = fn(
-            self.params, self.updater_state, states, xs, ys, masks, rngs, it0)
+            self.params, self.updater_state, states, xs, ys, masks, it0)
         self.params = params
         self.updater_state = opt_state
         self._update_count += k
@@ -428,15 +434,17 @@ class MultiLayerNetwork:
             return (params, opt_state, kept), loss
 
         def repeat_steps(params, opt_state, states, x, y, mask, it0, k):
-            # unroll=2: XLA removes inter-iteration carry copies between the
-            # paired bodies (measured ~1.2 ms/step on ResNet-50 @ v5e)
+            # unroll (default 2): XLA removes inter-iteration carry copies
+            # between the paired bodies (measured ~1.2 ms/step on ResNet-50
+            # @ v5e); DL4JTPU_SCAN_UNROLL overrides for tuning
             (params, opt_state, states), losses = jax.lax.scan(
                 functools.partial(one, x, y, mask), (params, opt_state, states),
-                it0 + jnp.arange(k), unroll=2)
+                it0 + jnp.arange(k), unroll=_xla.scan_unroll())
             return params, opt_state, states, losses
 
         return jax.jit(repeat_steps, donate_argnums=(0, 1, 2),
-                       static_argnums=(7,))
+                       static_argnums=(7,),
+                       compiler_options=_xla.train_step_options())
 
     def fit_repeated(self, x, y, k: int, mask=None):
         """Run K optimizer updates on one pre-staged batch in a single device

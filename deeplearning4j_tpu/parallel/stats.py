@@ -17,7 +17,6 @@ ops were synchronous).
 from __future__ import annotations
 
 import dataclasses
-import html
 import json
 import time
 from contextlib import contextmanager
@@ -97,58 +96,31 @@ class TrainingStats:
         })
 
     # ------------------------------------------------------------------
-    # HTML timeline (parity: StatsUtils.exportStatsAsHtml :69-92)
+    # HTML timeline (parity: StatsUtils.exportStatsAsHtml :69-92, built on
+    # the ui-components DSL exactly as the reference's Spark stats were)
     # ------------------------------------------------------------------
 
-    _COLORS = ["#4c78a8", "#f58518", "#54a24b", "#e45756", "#72b7b2",
-               "#b279a2", "#eeca3b", "#9d755d"]
+    def as_components(self) -> list:
+        """Timeline + summary table as UI components."""
+        from ..ui.components import ChartTimeline, ComponentTable
+        timeline = ChartTimeline("Phase timeline")
+        for p in self.phases():
+            timeline.add_lane(p, [
+                (e.start_ms, e.start_ms + e.duration_ms,
+                 f"{p}: {e.duration_ms:.2f} ms @ {e.start_ms:.1f} ms")
+                for e in self.events if e.phase == p])
+        table = ComponentTable(
+            ["phase", "count", "total ms", "mean ms", "min ms", "max ms"],
+            [[p, s["count"], s["total_ms"], s["mean_ms"], s["min_ms"],
+              s["max_ms"]] for p, s in self.summary().items()],
+            title="Per-phase summary")
+        return [timeline, table]
 
     def export_html(self, path: str, title: str = "Training phase timeline"
                     ) -> None:
         """Standalone HTML: one swimlane per phase, a rect per event."""
-        phases = self.phases()
-        if not self.events:
-            end = 1.0
-        else:
-            end = max(e.start_ms + e.duration_ms for e in self.events)
-        width, lane_h, label_w = 960.0, 28.0, 160.0
-        scale = (width - label_w - 20) / max(end, 1e-9)
-        rows = []
-        for i, p in enumerate(phases):
-            y = 30 + i * lane_h
-            color = self._COLORS[i % len(self._COLORS)]
-            rows.append(
-                f'<text x="4" y="{y + 18}" font-size="12">'
-                f'{html.escape(p)}</text>')
-            for e in self.events:
-                if e.phase != p:
-                    continue
-                x = label_w + e.start_ms * scale
-                w = max(e.duration_ms * scale, 0.75)
-                rows.append(
-                    f'<rect x="{x:.2f}" y="{y + 4}" width="{w:.2f}" '
-                    f'height="{lane_h - 8}" fill="{color}">'
-                    f'<title>{html.escape(p)}: {e.duration_ms:.2f} ms @ '
-                    f'{e.start_ms:.1f} ms</title></rect>')
-        height = 40 + len(phases) * lane_h
-        summary_rows = "".join(
-            f"<tr><td>{html.escape(p)}</td><td>{s['count']}</td>"
-            f"<td>{s['total_ms']}</td><td>{s['mean_ms']}</td>"
-            f"<td>{s['min_ms']}</td><td>{s['max_ms']}</td></tr>"
-            for p, s in self.summary().items())
-        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
-<title>{html.escape(title)}</title>
-<style>body{{font-family:sans-serif;margin:20px}}
-table{{border-collapse:collapse}}td,th{{border:1px solid #ccc;
-padding:4px 8px;font-size:13px}}</style></head><body>
-<h2>{html.escape(title)}</h2>
-<svg width="{width:.0f}" height="{height:.0f}">{''.join(rows)}</svg>
-<h3>Per-phase summary</h3>
-<table><tr><th>phase</th><th>count</th><th>total ms</th><th>mean ms</th>
-<th>min ms</th><th>max ms</th></tr>{summary_rows}</table>
-</body></html>"""
-        with open(path, "w") as f:
-            f.write(doc)
+        from ..ui.components import StaticPageUtil
+        StaticPageUtil.save_html(self.as_components(), path, title)
 
 
 @contextmanager

@@ -8,5 +8,8 @@ no SBE codecs — JSON over HTTP).
 
 from .server import UIServer
 from .stats import StatsListener
+from .listeners import ConvolutionalIterationListener
+from . import components
 
-__all__ = ["StatsListener", "UIServer"]
+__all__ = ["StatsListener", "UIServer", "ConvolutionalIterationListener",
+           "components"]

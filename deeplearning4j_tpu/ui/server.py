@@ -13,6 +13,12 @@ Endpoints:
   GET  /api/static?sid=     model/static info
   GET  /api/histograms?sid= latest param/update histograms + norm series
                             (parity: HistogramModule)
+  GET  /api/flow?sid=       network topology nodes+edges from config JSON
+                            (parity: FlowListenerModule)
+  GET  /api/activations?sid= latest conv activation grid
+                            (parity: ConvolutionalListenerModule)
+  GET  /api/tsne?sid=       stored t-SNE embedding (parity: TsneModule)
+  POST /api/tsne            upload coords, or raw vectors to embed
   POST /api/remote          receive stats records POSTed by
                             RemoteUIStatsStorageRouter from other hosts
                             (parity: RemoteReceiverModule)
@@ -44,6 +50,11 @@ _PAGE = """<!DOCTYPE html>
  <div id="hists"></div></div>
 <div class="card"><b>Update:param ratio (log10)</b><svg id="ratios"></svg>
  <div id="ratio_legend" style="font-size:11px"></div></div>
+<div class="card"><b>Network flow</b><svg id="flow" style="height:auto"></svg></div>
+<div class="card"><b>Conv activations</b> (latest probe)
+ <div id="acts" style="font-size:11px"></div></div>
+<div class="card"><b>t-SNE</b> (uploaded / embedded points)
+ <svg id="tsne" style="height:420px"></svg></div>
 <div class="card"><b>Model</b><pre id="model"></pre></div>
 <script>
 async function j(u){return (await fetch(u)).json()}
@@ -125,6 +136,91 @@ async function refresh(){
   multiline('ratios', series, 'ratio_legend');
   const s=await j('/api/static?sid='+sid);
   document.getElementById('model').textContent=JSON.stringify(s,null,1);
+  flowChart(await j('/api/flow?sid='+sid));
+  actGrid(await j('/api/activations?sid='+sid));
+  tsneChart(await j('/api/tsne?sid='+sid));
+}
+function flowChart(g){
+  const el=document.getElementById('flow'); el.innerHTML='';
+  if(!g.nodes.length) return;
+  // layered left-to-right layout: depth = longest path from an input
+  const depth={};
+  g.nodes.forEach(n=>{depth[n.id]=0});
+  for(let pass=0;pass<g.nodes.length;pass++)
+    g.edges.forEach(([a,b])=>{depth[b]=Math.max(depth[b],depth[a]+1)});
+  const cols={};
+  g.nodes.forEach(n=>{(cols[depth[n.id]]=cols[depth[n.id]]||[]).push(n)});
+  const BW=150,BH=30,GX=40,GY=12,pos={};
+  let maxRow=1,maxCol=0;
+  Object.entries(cols).forEach(([d,ns])=>{
+    maxRow=Math.max(maxRow,ns.length); maxCol=Math.max(maxCol,+d);
+    ns.forEach((n,i)=>{pos[n.id]=[8+d*(BW+GX), 8+i*(BH+GY)]});
+  });
+  const H=16+maxRow*(BH+GY), W=16+(maxCol+1)*(BW+GX);
+  let out='';
+  g.edges.forEach(([a,b])=>{
+    const [x1,y1]=pos[a],[x2,y2]=pos[b];
+    out+=`<line x1="${x1+BW}" y1="${y1+BH/2}" x2="${x2}" y2="${y2+BH/2}"
+      stroke="#999" marker-end="url(#arr)"/>`;
+  });
+  g.nodes.forEach(n=>{
+    const [x,y]=pos[n.id];
+    const c=n.kind==='input'?'#e8f0d8':'#dce8f8';
+    out+=`<rect x="${x}" y="${y}" width="${BW}" height="${BH}" rx="4"
+      fill="${c}" stroke="#667"/>
+      <text x="${x+6}" y="${y+19}" font-size="10">${n.label}</text>`;
+  });
+  // viewBox + height so deep DAGs (ResNet-50 ~50 columns) scale to the
+  // card width instead of clipping at it
+  el.setAttribute('height',H);
+  el.setAttribute('viewBox',`0 0 ${W} ${H}`);
+  el.setAttribute('preserveAspectRatio','xMinYMin meet');
+  el.innerHTML='<defs><marker id="arr" markerWidth="8" markerHeight="8" '+
+    'refX="7" refY="3" orient="auto"><path d="M0,0 L7,3 L0,6 z" '+
+    'fill="#999"/></marker></defs>'+out;
+}
+function actGrid(a){
+  const el=document.getElementById('acts');
+  if(!a.maps){el.innerHTML='(no activation records — attach a '+
+    'ConvolutionalIterationListener)'; return;}
+  el.innerHTML=`layer ${a.layer} @ iteration ${a.iteration}, `+
+    `shape ${a.shape.join('x')}<br>`;
+  a.maps.forEach(m=>{
+    const h=m.length,w=m[0].length,cell=Math.max(1,Math.floor(64/w));
+    const cv=document.createElement('canvas');
+    cv.width=w*cell; cv.height=h*cell; cv.style.margin='2px';
+    const ctx=cv.getContext('2d');
+    m.forEach((row,y)=>row.forEach((v,x)=>{
+      const g=Math.round(v*255);
+      ctx.fillStyle=`rgb(${g},${g},${g})`;
+      ctx.fillRect(x*cell,y*cell,cell,cell);
+    }));
+    el.appendChild(cv);
+  });
+}
+function tsneChart(t){
+  const el=document.getElementById('tsne'); el.innerHTML='';
+  if(!t.coords||!t.coords.length) return;
+  const W=900,H=420,P=20;
+  const xs=t.coords.map(c=>c[0]),ys=t.coords.map(c=>c[1]);
+  const xmin=Math.min(...xs),xmax=Math.max(...xs);
+  const ymin=Math.min(...ys),ymax=Math.max(...ys);
+  const sx=x=>P+(x-xmin)/(xmax-xmin||1)*(W-2*P);
+  const sy=y=>H-P-(y-ymin)/(ymax-ymin||1)*(H-2*P);
+  const colors=['#1565c0','#e65100','#2e7d32','#c62828','#6a1b9a',
+                '#00838f','#f9a825','#4e342e'];
+  let labelIdx={},next=0,out='';
+  t.coords.forEach((c,i)=>{
+    let col='#1565c0';
+    if(t.labels){
+      const l=t.labels[i];
+      if(!(l in labelIdx)) labelIdx[l]=next++;
+      col=colors[labelIdx[l]%colors.length];
+    }
+    out+=`<circle cx="${sx(c[0])}" cy="${sy(c[1])}" r="2.5"
+      fill="${col}" fill-opacity="0.7"/>`;
+  });
+  el.innerHTML=out;
 }
 async function init(){
   const sessions=await j('/api/sessions');
@@ -183,6 +279,34 @@ class _Handler(BaseHTTPRequestHandler):
                     out[wid] = {k: v for k, v in rec.data.items()
                                 if k != "config_json"}
             self._json(out)
+        elif url.path == "/api/flow":
+            # network topology from the posted config JSON (parity:
+            # FlowListenerModule — live network-flow diagram)
+            sid = q.get("sid", [""])[0]
+            self._json(self._flow_graph(sid))
+        elif url.path == "/api/activations":
+            # latest conv activation grid (parity:
+            # ConvolutionalListenerModule)
+            sid = q.get("sid", [""])[0]
+            from .listeners import ACTIVATIONS_TYPE_ID
+            latest = {}
+            for wid in st.list_workers(sid, ACTIVATIONS_TYPE_ID):
+                for rec in st.get_all_updates_after(
+                        sid, ACTIVATIONS_TYPE_ID, wid, 0.0):
+                    it = rec.data.get("iteration", -1)
+                    if it >= latest.get("iteration", -1):
+                        latest = rec.data
+            self._json(latest)
+        elif url.path == "/api/tsne":
+            # stored t-SNE embeddings (parity: TsneModule)
+            sid = q.get("sid", [""])[0]
+            latest = {}
+            for wid in st.list_workers(sid, "TsneModule"):
+                for rec in st.get_all_updates_after(sid, "TsneModule",
+                                                    wid, 0.0):
+                    if rec.timestamp >= latest.get("timestamp", -1):
+                        latest = {"timestamp": rec.timestamp, **rec.data}
+            self._json(latest)
         elif url.path == "/api/histograms":
             # latest param histograms + per-param norm time series
             # (parity: the reference HistogramModule's data feed)
@@ -216,8 +340,72 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._json({"error": "not found"}, 404)
 
+    def _flow_graph(self, sid: str):
+        """Nodes + edges parsed from the session's static config_json."""
+        st = self.storage
+        for wid in st.list_workers(sid, "StatsListener"):
+            rec = st.get_static_info(sid, "StatsListener", wid)
+            if not rec or "config_json" not in rec.data:
+                continue
+            conf = json.loads(rec.data["config_json"])
+            nodes, edges = [], []
+            if "vertices" in conf:  # ComputationGraph DAG
+                for name in conf.get("network_inputs", []):
+                    nodes.append({"id": name, "label": name, "kind": "input"})
+                for name, v in conf["vertices"].items():
+                    layer = (v.get("layer") or {}).get("__layer__") or {}
+                    kind = layer.get("type") or v.get("type", "vertex")
+                    nodes.append({"id": name, "label": f"{name} ({kind})",
+                                  "kind": kind})
+                for dst, srcs in conf.get("vertex_inputs", {}).items():
+                    for s in srcs:
+                        edges.append([s, dst])
+            else:  # MultiLayerNetwork chain
+                nodes.append({"id": "input", "label": "input",
+                              "kind": "input"})
+                prev = "input"
+                for i, layer in enumerate(conf.get("layers", [])):
+                    nid = layer.get("name") or f"layer_{i}"
+                    nodes.append({"id": nid,
+                                  "label": f"{nid} ({layer.get('type')})",
+                                  "kind": layer.get("type", "layer")})
+                    edges.append([prev, nid])
+                    prev = nid
+            return {"nodes": nodes, "edges": edges}
+        return {"nodes": [], "edges": []}
+
     def do_POST(self):
         url = urlparse(self.path)
+        if url.path == "/api/tsne":
+            # upload coordinates, or raw vectors to embed server-side
+            # (parity: TsneModule's coordinate-file upload)
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(length).decode())
+                sid = payload.get("sid", "default")
+                labels = payload.get("labels")
+                if "coords" in payload:
+                    coords = payload["coords"]
+                else:
+                    from ..plot.tsne import BarnesHutTsne
+                    import numpy as np
+                    vecs = np.asarray(payload["vectors"], dtype=np.float64)
+                    ts = BarnesHutTsne(
+                        n_components=2,
+                        perplexity=float(payload.get("perplexity", 30.0)),
+                        max_iter=int(payload.get("iterations", 250)),
+                        seed=int(payload.get("seed", 0)))
+                    coords = np.round(ts.fit_transform(vecs), 4).tolist()
+                from ..storage.stats_storage import Persistable
+                import time as _time
+                self.storage.put_update(Persistable(
+                    session_id=sid, type_id="TsneModule",
+                    worker_id="upload", timestamp=_time.time(),
+                    data={"coords": coords, "labels": labels}))
+                self._json({"ok": True, "n": len(coords)})
+            except Exception as e:
+                self._json({"error": str(e)}, 400)
+            return
         if url.path != "/api/remote":
             self._json({"error": "not found"}, 404)
             return

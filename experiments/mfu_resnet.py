@@ -1,0 +1,57 @@
+"""ResNet-50 MFU experiment harness (round-4 continuation of PERF.md).
+
+Runs bench-methodology measurements of the ResNet-50 train step under one
+variation per invocation, selected by argv[1]:
+
+  baseline      unroll=2 (shipping config)
+  unroll4       lax.scan unroll=4
+  unroll8       lax.scan unroll=8
+  lhs           compiler_options latency-hiding-scheduler
+  f32stats      (see bench note) nothing — placeholder for ablations
+
+Usage: python experiments/mfu_resnet.py baseline unroll4 ...
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def measure(tag, env=None, compiler_options=None, k=32, rounds=2):
+    for key, val in (env or {}).items():
+        os.environ[key] = val
+    import jax
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import (_make_resnet, _stage_batches,
+                       _resnet50_train_flops_per_example,
+                       _peak_flops_per_sec)
+
+    net, image, batch = _make_resnet()
+    xs, ys = _stage_batches(1, batch, (image, image, 3), 1000, seed=11)
+    x, y = jax.device_put(xs[0]), jax.device_put(ys[0])
+    np.asarray(net.fit_repeated([x], [y], k))  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        losses = net.fit_repeated([x], [y], k)
+    np.asarray(losses)
+    dt = time.perf_counter() - t0
+    steps = rounds * k
+    step_ms = 1000 * dt / steps
+    eps = steps * batch / dt
+    mfu = eps * _resnet50_train_flops_per_example(image) / _peak_flops_per_sec()
+    print(f"RESULT {tag}: step_ms={step_ms:.2f} mfu={mfu:.4f} "
+          f"eps={eps:.1f}", flush=True)
+    return step_ms, mfu
+
+
+if __name__ == "__main__":
+    tag = sys.argv[1] if len(sys.argv) > 1 else "baseline"
+    env = {}
+    if tag == "unroll4":
+        env["DL4JTPU_SCAN_UNROLL"] = "4"
+    elif tag == "unroll8":
+        env["DL4JTPU_SCAN_UNROLL"] = "8"
+    measure(tag, env=env)

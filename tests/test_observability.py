@@ -187,3 +187,178 @@ class TestRemoteRouting:
                 base + "/api/sessions", timeout=5).read()) == []
         finally:
             server.stop()
+
+
+class TestUIModules:
+    """Flow / conv-activations / t-SNE modules (parity: reference
+    FlowListenerModule, ConvolutionalListenerModule, TsneModule)."""
+
+    def test_flow_endpoint_mln(self, rng):
+        st = InMemoryStatsStorage()
+        server = UIServer(port=0).attach(st)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            _train_with_listener(rng, st, iterations=1)
+            g = json.loads(urllib.request.urlopen(
+                base + "/api/flow?sid=test_session", timeout=5).read())
+            ids = [n["id"] for n in g["nodes"]]
+            assert ids[0] == "input" and len(ids) == 3
+            assert g["edges"] == [["input", "layer_0"],
+                                  ["layer_0", "layer_1"]]
+        finally:
+            server.stop()
+
+    def test_flow_endpoint_graph(self):
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+        b = (NeuralNetConfiguration.builder().seed(3).graph_builder()
+             .add_inputs("in")
+             .add_layer("d1", DenseLayer(n_in=5, n_out=8, activation="tanh"),
+                        "in")
+             .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                           activation="softmax",
+                                           loss="mcxent"), "d1")
+             .set_outputs("out"))
+        net = ComputationGraph(b.build()).init()
+        st = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(st, session_id="g"))
+        x = np.random.RandomState(0).randn(8, 5).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.random.RandomState(1).randint(0, 3, 8)]
+        net.fit_batch([x], [y])
+        server = UIServer(port=0).attach(st)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            g = json.loads(urllib.request.urlopen(
+                base + "/api/flow?sid=g", timeout=5).read())
+            ids = {n["id"] for n in g["nodes"]}
+            assert {"in", "d1", "out"} <= ids
+            assert ["in", "d1"] in g["edges"] and ["d1", "out"] in g["edges"]
+        finally:
+            server.stop()
+
+    def test_conv_activations_listener_and_endpoint(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       SubsamplingLayer)
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+
+        conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(4, 8, 8, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+        st = InMemoryStatsStorage()
+        net.set_listeners(ConvolutionalIterationListener(
+            st, probe_input=x, frequency=1, session_id="conv",
+            max_channels=3, max_size=8))
+        net.fit_batch(x, y)
+        net.fit_batch(x, y)
+        server = UIServer(port=0).attach(st)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            a = json.loads(urllib.request.urlopen(
+                base + "/api/activations?sid=conv", timeout=5).read())
+            assert a["iteration"] == 2
+            assert len(a["maps"]) == 3           # capped channels
+            grid = np.asarray(a["maps"][0])
+            assert grid.ndim == 2
+            assert 0.0 <= grid.min() and grid.max() <= 1.0
+        finally:
+            server.stop()
+
+    def test_tsne_module_roundtrip(self):
+        st = InMemoryStatsStorage()
+        server = UIServer(port=0).attach(st)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            body = json.dumps({"sid": "t", "coords": [[0, 1], [2, 3]],
+                               "labels": ["a", "b"]}).encode()
+            req = urllib.request.Request(base + "/api/tsne", data=body,
+                                         method="POST")
+            out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+            assert out["ok"] and out["n"] == 2
+            got = json.loads(urllib.request.urlopen(
+                base + "/api/tsne?sid=t", timeout=5).read())
+            assert got["coords"] == [[0, 1], [2, 3]]
+            assert got["labels"] == ["a", "b"]
+        finally:
+            server.stop()
+
+    def test_tsne_module_embeds_vectors(self):
+        st = InMemoryStatsStorage()
+        server = UIServer(port=0).attach(st)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            vecs = np.random.RandomState(0).randn(60, 8).tolist()
+            body = json.dumps({"sid": "v", "vectors": vecs,
+                               "iterations": 20, "perplexity": 10}).encode()
+            req = urllib.request.Request(base + "/api/tsne", data=body,
+                                         method="POST")
+            out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert out["n"] == 60
+            got = json.loads(urllib.request.urlopen(
+                base + "/api/tsne?sid=v", timeout=5).read())
+            coords = np.asarray(got["coords"])
+            assert coords.shape == (60, 2)
+            assert np.all(np.isfinite(coords))
+        finally:
+            server.stop()
+
+
+class TestUIComponents:
+    """ui-components DSL (parity: reference deeplearning4j-ui-components)."""
+
+    def test_json_roundtrip_all_types(self):
+        from deeplearning4j_tpu.ui.components import (
+            ChartHistogram, ChartLine, ChartScatter, ChartTimeline,
+            Component, ComponentDiv, ComponentTable, ComponentText)
+
+        comps = [
+            ChartLine("l").add_series("a", [0, 1], [2, 3]),
+            ChartScatter("s").add_series("b", [0.5], [1.5]),
+            ChartHistogram("h").add_bin(0, 1, 4).add_bin(1, 2, 6),
+            ChartTimeline("t").add_lane("lane", [(0, 2, "e1"), (3, 5, "e2")]),
+            ComponentTable(["x", "y"], [[1, 2], [3, 4]], title="tab"),
+            ComponentText("hello"),
+        ]
+        comps.append(ComponentDiv(*comps[:2], style="margin:0"))
+        for c in comps:
+            c2 = Component.from_json(c.to_json())
+            assert type(c2) is type(c)
+            assert c2.render() == c.render()
+
+    def test_static_page_render(self, tmp_path):
+        from deeplearning4j_tpu.ui.components import (ChartLine,
+                                                      StaticPageUtil)
+
+        chart = ChartLine("scores").add_series("train", [0, 1, 2],
+                                               [1.0, 0.6, 0.4])
+        p = tmp_path / "page.html"
+        StaticPageUtil.save_html([chart], str(p), title="report")
+        html = p.read_text()
+        assert html.startswith("<!DOCTYPE html>")
+        assert "<svg" in html and "scores" in html and "report" in html
+
+    def test_training_stats_exports_via_components(self, tmp_path):
+        from deeplearning4j_tpu.parallel.stats import TrainingStats
+
+        ts = TrainingStats()
+        ts.record("step", 0.0, 5.0)
+        ts.record("average", 5.0, 2.0)
+        comps = ts.as_components()
+        assert len(comps) == 2
+        p = tmp_path / "timeline.html"
+        ts.export_html(str(p))
+        html = p.read_text()
+        assert "Phase timeline" in html and "average" in html
+
+    def test_series_length_mismatch_raises(self):
+        from deeplearning4j_tpu.ui.components import ChartLine
+
+        with pytest.raises(ValueError):
+            ChartLine("l").add_series("a", [0, 1], [2])
