@@ -13,6 +13,8 @@ Layouts: activations NHWC ``[batch, h, w, channels]``, kernels HWIO
 
 from __future__ import annotations
 
+import functools
+
 from typing import Sequence, Tuple, Union
 
 import jax
@@ -32,9 +34,8 @@ def _pad_pairs(padding: Padding, kernel, stride, in_hw):
     return ((ph, ph), (pw, pw))
 
 
-def conv2d(x, w, stride=(1, 1), padding: Padding = (0, 0), dilation=(1, 1),
-           groups: int = 1, preferred_dtype=None):
-    """2D convolution, NHWC x HWIO -> NHWC."""
+def _conv2d_raw(x, w, stride=(1, 1), padding: Padding = (0, 0),
+                dilation=(1, 1), groups: int = 1, preferred_dtype=None):
     pad = _pad_pairs(padding, w.shape[:2], stride, x.shape[1:3])
     return lax.conv_general_dilated(
         x, w,
@@ -45,6 +46,81 @@ def conv2d(x, w, stride=(1, 1), padding: Padding = (0, 0), dilation=(1, 1),
         feature_group_count=groups,
         preferred_element_type=preferred_dtype,
     )
+
+
+def conv2d(x, w, stride=(1, 1), padding: Padding = (0, 0), dilation=(1, 1),
+           groups: int = 1, preferred_dtype=None):
+    """2D convolution, NHWC x HWIO -> NHWC.
+
+    ``DL4JTPU_CONV_DW=matmul`` (undilated/ungrouped convs only) switches the
+    weight gradient to explicit [Cin, N·Ho·Wo] @ [N·Ho·Wo, Cout]
+    contractions, one per kernel tap; dx keeps XLA's standard derivation.
+    This is an alternative lowering shipped OFF: on v5e it measured ~33%
+    slower than XLA's fused transposed-conv dW inside the ResNet-50 train
+    step (63.3 vs 47.5 ms/step — PERF.md r4). Kept because it is exact
+    (f64 parity suite in tests/test_convdw.py) and other TPU generations /
+    conv mixes may rank the two differently.
+    """
+    if (_dw_mode() == "matmul" and groups == 1
+            and tuple(dilation) == (1, 1)):
+        return _conv2d_mmdw(x, w, tuple(stride), padding, preferred_dtype)
+    return _conv2d_raw(x, w, stride, padding, dilation, groups,
+                       preferred_dtype)
+
+
+def _dw_mode() -> str:
+    import os
+    return os.environ.get("DL4JTPU_CONV_DW", "")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _conv2d_mmdw(x, w, stride, padding, preferred_dtype):
+    return _conv2d_raw(x, w, stride, padding, (1, 1), 1, preferred_dtype)
+
+
+def _conv2d_mmdw_fwd(x, w, stride, padding, preferred_dtype):
+    return _conv2d_mmdw(x, w, stride, padding, preferred_dtype), (x, w)
+
+
+def _conv2d_mmdw_bwd(stride, padding, preferred_dtype, res, dy):
+    x, w = res
+    # dx: XLA's standard transposed-conv derivation; linear_transpose (not
+    # vjp) so the eager backward doesn't re-execute the discarded primal
+    dx, = jax.linear_transpose(
+        lambda xx: _conv2d_raw(xx, w, stride, padding, (1, 1), 1,
+                               preferred_dtype), x)(dy)
+    # dW: one tall-skinny matmul per kernel tap
+    kh, kw, cin, cout = w.shape
+    sh, sw = stride
+    n, ho, wo, _ = dy.shape
+    pad = _pad_pairs(padding, (kh, kw), stride, x.shape[1:3])
+    if isinstance(pad, str):
+        # exactly XLA's SAME/VALID lo/hi split
+        pads = lax.padtype_to_pads(x.shape[1:3], (kh, kw), (sh, sw), pad)
+    else:
+        pads = list(pad)
+    # lax.pad, not jnp.pad: eager jnp.pad returns uninitialized memory on the
+    # forced-multi-device CPU backend used by the test mesh (jax 0.9.0)
+    xp = lax.pad(x, jnp.zeros((), x.dtype),
+                 ((0, 0, 0), (*pads[0], 0), (*pads[1], 0), (0, 0, 0)))
+    dy2 = dy.reshape(n * ho * wo, cout)
+    taps = []
+    for p in range(kh):
+        for q in range(kw):
+            # input window feeding output pixel (h,w) through tap (p,q)
+            xs = lax.slice(xp, (0, p, q, 0),
+                           (n, p + (ho - 1) * sh + 1, q + (wo - 1) * sw + 1,
+                            cin), (1, sh, sw, 1))
+            x2 = xs.reshape(n * ho * wo, cin)
+            taps.append(lax.dot_general(
+                x2, dy2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.promote_types(x.dtype,
+                                                         jnp.float32)))
+    dw = jnp.stack(taps).reshape(kh, kw, cin, cout).astype(w.dtype)
+    return dx, dw
+
+
+_conv2d_mmdw.defvjp(_conv2d_mmdw_fwd, _conv2d_mmdw_bwd)
 
 
 def conv_output_size(in_size: int, kernel: int, stride: int, pad: int,
