@@ -58,18 +58,12 @@ def _run_config(out: dict, name: str, fn) -> dict | None:
 
 
 def _peak_flops_per_sec() -> float:
-    """Per-chip peak (bf16). TPU v5e ≈ 197 TFLOP/s."""
-    import jax
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v4" in kind:
-        return 275e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v6" in kind:
-        return 918e12
-    return 197e12
+    """Per-chip peak (bf16) — single source of truth in util/profiling.py."""
+    from deeplearning4j_tpu.util import profiling
+    try:
+        return profiling.peak_flops_per_sec()
+    except ValueError:
+        return 197e12  # unknown kind: assume v5e (this harness's chip)
 
 
 def _conv_flops_nhwc(h, w, c_in, c_out, kh, kw, stride):

@@ -388,3 +388,65 @@ class IrisDataSetIterator(ArrayDataSetIterator):
         labels = np.concatenate(labels)
         order = rng.permutation(len(feats))
         super().__init__(feats[order], _one_hot(labels[order], 3), batch_size)
+
+
+# ----------------------------------------------------------------------
+# Curves — CurvesDataFetcher analog
+# ----------------------------------------------------------------------
+
+
+def _synthetic_curves(n: int, seed: int, size: int = 28) -> np.ndarray:
+    """28×28 grayscale images of random cubic Bézier curves — the shape of
+    Hinton's deep-autoencoder "curves" dataset.
+
+    Parity: ``deeplearning4j-core/.../datasets/fetchers/CurvesDataFetcher.java``
+    downloads a pre-serialized ND4J DataSet (a JVM binary this framework
+    deliberately does not parse); this is a faithful generative surrogate —
+    each example is a smooth random curve rasterized with anti-aliasing,
+    matching the original's construction (random control points → curve
+    image) and its unsupervised use (features double as targets).
+    """
+    rng = np.random.default_rng(seed)
+    # sample the Bézier densely and splat with bilinear weights
+    t = np.linspace(0.0, 1.0, 160)
+    b0 = (1 - t) ** 3
+    b1 = 3 * t * (1 - t) ** 2
+    b2 = 3 * t ** 2 * (1 - t)
+    b3 = t ** 3
+    imgs = np.zeros((n, size, size), dtype=np.float32)
+    pts = rng.uniform(2.0, size - 3.0, size=(n, 4, 2))
+    basis = np.stack([b0, b1, b2, b3])                  # [4, T]
+    curves = np.einsum("kt,nkd->ntd", basis, pts)       # [n, T, 2]
+    cx, cy = curves[..., 0], curves[..., 1]             # [n, T]
+    x0, y0 = np.floor(cx).astype(int), np.floor(cy).astype(int)
+    fx, fy = cx - x0, cy - y0
+    idx = np.broadcast_to(np.arange(n)[:, None], cx.shape)
+    np.add.at(imgs, (idx, y0, x0), (1 - fx) * (1 - fy))
+    np.add.at(imgs, (idx, y0, x0 + 1), fx * (1 - fy))
+    np.add.at(imgs, (idx, y0 + 1, x0), (1 - fx) * fy)
+    np.add.at(imgs, (idx, y0 + 1, x0 + 1), fx * fy)
+    return np.clip(imgs, 0.0, 1.0).reshape(n, size * size)
+
+
+class CurvesDataSetIterator(ArrayDataSetIterator):
+    """Curves dataset for unsupervised pretraining (labels == features, the
+    autoencoder convention of the reference's fetcher).
+
+    Parity: ``CurvesDataFetcher.java`` + ``datasets/iterator/impl`` usage in
+    deep-autoencoder examples. A cached ``curves.npz`` (key ``data``,
+    [n, 784] float) under the dataset cache dirs is used when present;
+    otherwise the generative surrogate above.
+    """
+
+    def __init__(self, batch_size: int = 100, num_examples: int = 1000,
+                 seed: int = 42):
+        data = None
+        for d in _cache_dirs("curves"):
+            f = d / "curves.npz"
+            if f.exists():
+                data = np.load(f)["data"][:num_examples].astype(np.float32)
+                break
+        self.synthetic = data is None
+        if data is None:
+            data = _synthetic_curves(num_examples, seed)
+        super().__init__(data, data.copy(), batch_size)

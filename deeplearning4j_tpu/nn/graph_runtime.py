@@ -332,6 +332,9 @@ class ComputationGraph:
             listeners = tuple(listeners[0])
         self.listeners = list(listeners)
 
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
     def _fire_iteration(self, batch_size, loss):
         self.iteration_count += 1
         for l in self.listeners:

@@ -5,5 +5,8 @@ Parity: reference ``deeplearning4j-nn/.../util/`` — chiefly
 """
 
 from .serialization import ModelSerializer, load_model, save_model
+from .recovery import CheckpointRecovery, RecoverableTrainer
+from . import profiling
 
-__all__ = ["ModelSerializer", "save_model", "load_model"]
+__all__ = ["ModelSerializer", "save_model", "load_model",
+           "CheckpointRecovery", "RecoverableTrainer", "profiling"]

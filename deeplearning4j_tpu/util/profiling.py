@@ -1,0 +1,126 @@
+"""Profiling / tracing helpers: device trace capture, step timing, MFU.
+
+Parity: the reference's three timing systems (SURVEY §5) —
+``PerformanceListener.java:71-86`` (samples/sec), the Spark phase timers
+(``StatsUtils.java:69-92``), and StatsListener's fwd/bwd breakdown — plus
+the capability the reference never had: capturing a compiler-level device
+trace. TPU-native: wraps ``jax.profiler`` (XPlane traces viewable in
+TensorBoard / Perfetto) and provides the analytic-FLOPs MFU arithmetic used
+by bench.py, so users chase utilization the way PERF.md does.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,     # jax device_kind string for v5e
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_per_sec(device=None) -> float:
+    """bf16 peak of the attached chip (first device by default)."""
+    import jax
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    raise ValueError(
+        f"unknown device kind {kind!r}; pass peak FLOPs explicitly")
+
+
+def mfu(examples_per_sec: float, flops_per_example: float,
+        peak: Optional[float] = None) -> float:
+    """Model FLOPs utilization: useful analytic FLOPs over peak. The
+    standard convention — no recompute/rematerialization inflation."""
+    return examples_per_sec * flops_per_example / (peak
+                                                   or peak_flops_per_sec())
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace (XPlane) into ``log_dir``; view in
+    TensorBoard's profile plugin or Perfetto."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class StepTiming:
+    mean_ms: float
+    min_ms: float
+    max_ms: float
+    steps: int
+
+
+def time_steps(step_fn: Callable[[], object], steps: int = 10,
+               warmup: int = 2) -> StepTiming:
+    """Wall-time a step callable with a proper device barrier per sample.
+
+    The completion barrier is a device→host transfer of (a tiny slice of)
+    the step result — on remote-attached devices ``block_until_ready`` can
+    return before execution finishes (see bench.py), so a d2h read is the
+    only trustworthy fence.
+    """
+    def run_once() -> float:
+        t0 = time.perf_counter()
+        out = step_fn()
+        _barrier(out)
+        return (time.perf_counter() - t0) * 1000.0
+
+    for _ in range(warmup):
+        run_once()
+    samples = [run_once() for _ in range(steps)]
+    return StepTiming(mean_ms=float(np.mean(samples)),
+                      min_ms=float(np.min(samples)),
+                      max_ms=float(np.max(samples)), steps=steps)
+
+
+def _barrier(out) -> None:
+    import jax
+    leaves = jax.tree_util.tree_leaves(out)
+    for leaf in leaves:
+        if hasattr(leaf, "addressable_shards") or hasattr(leaf, "device"):
+            flat = jax.numpy.ravel(leaf)
+            np.asarray(flat[:1])
+            return
+    # no device values returned: nothing to fence
+
+
+# ----------------------------------------------------------------------
+# Analytic FLOPs for common layer shapes (used by bench.py's configs)
+# ----------------------------------------------------------------------
+
+def conv2d_flops(out_h: int, out_w: int, kh: int, kw: int, cin: int,
+                 cout: int) -> float:
+    """MACs×2 for one example's conv forward."""
+    return 2.0 * out_h * out_w * kh * kw * cin * cout
+
+
+def dense_flops(n_in: int, n_out: int) -> float:
+    return 2.0 * n_in * n_out
+
+
+def lstm_flops(seq_len: int, n_in: int, hidden: int) -> float:
+    """Gates: 4 matmuls of [n_in+hidden, hidden] per timestep."""
+    return 2.0 * seq_len * 4 * (n_in + hidden) * hidden
+
+
+def train_flops(forward_flops: float) -> float:
+    """Training step ≈ 3× forward (fwd + dx + dW), the standard accounting."""
+    return 3.0 * forward_flops

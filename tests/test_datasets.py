@@ -207,3 +207,53 @@ class TestFetchers:
         ds = it.next()
         assert ds.features.shape == (5, 24, 24, 3)
         assert ds.labels.shape == (5, 2)
+
+
+class TestCurves:
+    def test_curves_iterator_shapes_and_autoencoder_labels(self):
+        from deeplearning4j_tpu.datasets.fetchers import CurvesDataSetIterator
+
+        it = CurvesDataSetIterator(batch_size=16, num_examples=48, seed=3)
+        n = 0
+        while it.has_next():
+            ds = it.next()
+            assert ds.features.shape == (16, 784)
+            # unsupervised: labels are the features (autoencoder convention)
+            np.testing.assert_array_equal(np.asarray(ds.features),
+                                          np.asarray(ds.labels))
+            assert 0.0 <= float(np.min(ds.features))
+            assert float(np.max(ds.features)) <= 1.0
+            n += 1
+        assert n == 3
+
+    def test_curves_deterministic_by_seed(self):
+        from deeplearning4j_tpu.datasets.fetchers import CurvesDataSetIterator
+
+        a = CurvesDataSetIterator(batch_size=8, num_examples=8, seed=5).next()
+        b = CurvesDataSetIterator(batch_size=8, num_examples=8, seed=5).next()
+        c = CurvesDataSetIterator(batch_size=8, num_examples=8, seed=6).next()
+        np.testing.assert_array_equal(np.asarray(a.features),
+                                      np.asarray(b.features))
+        assert not np.allclose(np.asarray(a.features), np.asarray(c.features))
+
+    def test_curves_pretrain_autoencoder_reconstructs(self):
+        """The reference's use case: layerwise AE pretraining on curves."""
+        from deeplearning4j_tpu.datasets.fetchers import CurvesDataSetIterator
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        it = CurvesDataSetIterator(batch_size=32, num_examples=64, seed=1)
+        conf = (NeuralNetConfiguration.builder().seed(4).updater("adam")
+                .learning_rate(0.005).list()
+                .layer(DenseLayer(n_out=64, activation="relu"))
+                .layer(OutputLayer(n_out=784, activation="sigmoid",
+                                   loss="mse"))
+                .set_input_type(InputType.feed_forward(784)).build())
+        net = MultiLayerNetwork(conf).init()
+        ds = it.next()
+        first = float(np.asarray(net.fit_batch(ds.features, ds.labels)))
+        for _ in range(30):
+            last = float(np.asarray(net.fit_batch(ds.features, ds.labels)))
+        assert last < first

@@ -1,0 +1,58 @@
+"""Profiling helper tests (util/profiling.py — tracing + MFU arithmetic,
+SURVEY §5 'tracing/profiling')."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util import profiling
+
+
+class TestMfuArithmetic:
+    def test_mfu_with_explicit_peak(self):
+        # 1000 ex/s at 1e9 FLOP/example on a 1e13 peak = 10% MFU
+        assert profiling.mfu(1000.0, 1e9, peak=1e13) == pytest.approx(0.1)
+
+    def test_train_flops_is_3x_forward(self):
+        assert profiling.train_flops(7.0) == 21.0
+
+    def test_conv_dense_lstm_flops(self):
+        assert profiling.conv2d_flops(28, 28, 3, 3, 16, 32) == \
+            2 * 28 * 28 * 9 * 16 * 32
+        assert profiling.dense_flops(784, 100) == 2 * 784 * 100
+        assert profiling.lstm_flops(10, 32, 64) == 2 * 10 * 4 * (32 + 64) * 64
+
+    def test_peak_lookup_known_kinds(self):
+        assert profiling.PEAK_FLOPS["v5e"] == 197e12
+        assert profiling.PEAK_FLOPS["v5p"] == 459e12
+
+
+class TestTimeSteps:
+    def test_times_a_jitted_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: (x @ x).sum())
+        x = jnp.ones((64, 64))
+        timing = profiling.time_steps(lambda: f(x), steps=3, warmup=1)
+        assert timing.steps == 3
+        assert timing.min_ms <= timing.mean_ms <= timing.max_ms
+        assert timing.mean_ms > 0
+
+    def test_handles_host_only_result(self):
+        timing = profiling.time_steps(lambda: 42, steps=2, warmup=0)
+        assert timing.steps == 2
+
+
+class TestTrace:
+    def test_trace_writes_xplane(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        with profiling.trace(str(tmp_path)):
+            np.asarray(jax.jit(lambda x: x * 2)(jnp.ones((8,))))
+        found = []
+        for root, _, files in os.walk(tmp_path):
+            found += [f for f in files if f.endswith(".xplane.pb")]
+        assert found, "profiler should write an xplane trace"
