@@ -11,5 +11,7 @@ role); these builders produce the BASELINE.md configs:
 from .lenet import lenet
 from .resnet import resnet, resnet50
 from .char_rnn import char_rnn_lstm
+from .classic import alexnet, deep_autoencoder, vgg16
 
-__all__ = ["lenet", "resnet", "resnet50", "char_rnn_lstm"]
+__all__ = ["lenet", "resnet", "resnet50", "char_rnn_lstm",
+           "alexnet", "vgg16", "deep_autoencoder"]

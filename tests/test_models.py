@@ -115,3 +115,57 @@ class TestSpaceToDepthStem:
         loss0 = net.fit_batch([x], [y])
         loss1 = net.fit_batch([x], [y])
         assert np.isfinite(loss1) and float(loss1) < float(loss0) * 1.5
+
+
+class TestClassicZoo:
+    """AlexNet / VGG-16 / deep autoencoder builders (models/classic.py)."""
+
+    def test_alexnet_forward_and_shapes(self, rng):
+        from deeplearning4j_tpu.models import alexnet
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(alexnet(height=64, width=64, n_classes=7,
+                                        dtype="float32")).init()
+        x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 7)
+        assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_vgg16_trains(self, rng):
+        from deeplearning4j_tpu.models import vgg16
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(vgg16(height=32, width=32, n_classes=4,
+                                      updater="adam", learning_rate=1e-3,
+                                      dtype="float32")).init()
+        x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        # dropout makes single-step losses noisy: compare first vs the mean
+        # of the last three
+        losses = [float(np.asarray(net.fit_batch(x, y))) for _ in range(12)]
+        assert np.mean(losses[-3:]) < losses[0]
+
+    def test_deep_autoencoder_reconstructs_curves(self):
+        from deeplearning4j_tpu.datasets.fetchers import CurvesDataSetIterator
+        from deeplearning4j_tpu.models import deep_autoencoder
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(deep_autoencoder(
+            n_in=784, hidden=(256, 64, 16))).init()
+        ds = CurvesDataSetIterator(batch_size=64, num_examples=64,
+                                   seed=9).next()
+        first = float(np.asarray(net.fit_batch(ds.features, ds.labels)))
+        for _ in range(15):
+            last = float(np.asarray(net.fit_batch(ds.features, ds.labels)))
+        assert last < first
+
+    def test_zoo_configs_json_roundtrip(self):
+        from deeplearning4j_tpu.models import alexnet, deep_autoencoder, vgg16
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration)
+
+        for conf in (alexnet(height=64, width=64, n_classes=5),
+                     vgg16(height=32, width=32, n_classes=5),
+                     deep_autoencoder(n_in=32, hidden=(16, 8))):
+            restored = MultiLayerConfiguration.from_json(conf.to_json())
+            assert restored.to_json() == conf.to_json()
