@@ -10,40 +10,15 @@ None when no compiler is available; callers then use the pure-Python
 from __future__ import annotations
 
 import ctypes
-import hashlib
-import os
-import subprocess
-import tempfile
-import warnings
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
 
+from ..util.native import compile_and_load
+
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
-
-
-def _compile(src: Path) -> Optional[Path]:
-    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
-    out_dir = Path(tempfile.gettempdir()) / "dl4j_tpu_native"
-    out_dir.mkdir(parents=True, exist_ok=True)
-    so = out_dir / f"_sptree_{digest}.so"
-    if so.exists():
-        return so
-    # Compile to a process-private name, then atomically rename: a second
-    # process must never dlopen a half-written .so.
-    tmp = out_dir / f"_sptree_{digest}.{os.getpid()}.tmp.so"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++14",
-           "-o", str(tmp), str(src)]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, so)
-        return so
-    except Exception as e:
-        warnings.warn(f"SpTree native build failed ({e}); "
-                      "falling back to pure-Python Barnes-Hut")
-        return None
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -52,13 +27,9 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    src = Path(__file__).parent / "_sptree.cpp"
-    if not src.exists():
+    lib = compile_and_load(Path(__file__).parent / "_sptree.cpp")
+    if lib is None:
         return None
-    so = _compile(src)
-    if so is None:
-        return None
-    lib = ctypes.CDLL(str(so))
     lib.bh_tsne_gradient.restype = ctypes.c_int
     lib.bh_tsne_gradient.argtypes = [
         ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_int,

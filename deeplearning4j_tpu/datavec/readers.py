@@ -111,12 +111,28 @@ class CSVRecordReader(RecordReader):
         self._load()
 
     def _load(self) -> None:
+        from . import native as _native
         if self._lines is not None:
             raw = self._lines
+            body = raw[self.skip_lines:]
+            # skip by LIST ELEMENT (an element may hold embedded newlines) —
+            # so the native path always sees pre-skipped content
+            native_input = ("\n".join(body), 0)
         else:
             with open(self.path, "r", newline="") as f:
-                raw = f.read().splitlines()
-        body = raw[self.skip_lines:]
+                text = f.read()
+            raw = text.splitlines()
+            body = raw[self.skip_lines:]
+            native_input = (text, self.skip_lines)
+        # fast path: strictly numeric rectangular CSV parses in the native
+        # kernel (GIL released); strings/ragged rows fall back to Python csv
+        if _native.load() is not None:
+            src, skip = native_input
+            mat = _native.parse_numeric_csv(src.encode(), self.delimiter,
+                                            skip)
+            if mat is not None:
+                self._records = mat.tolist()
+                return
         reader = csv.reader(io.StringIO("\n".join(body)),
                             delimiter=self.delimiter)
         self._records = [[_parse_value(v) for v in row]

@@ -1,0 +1,65 @@
+"""Native-kernel build/load: the framework's C++ runtime pattern.
+
+The reference reaches native code through JavaCPP bindings to prebuilt
+libraries (libnd4j, cuDNN — SURVEY §2.3); here host-side hot loops ship as
+C++ sources compiled on first use with the system toolchain and bound via
+ctypes. One loader serves every kernel (`clustering/_sptree.cpp`,
+`datavec/_fastcsv.cpp`, ...): hash-keyed shared cache, atomic rename so
+concurrent first users never dlopen a half-written .so, graceful None when
+no compiler is available (callers keep a pure-Python fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import warnings
+from pathlib import Path
+from typing import Optional, Sequence
+
+_cache: dict = {}
+
+
+def compile_and_load(src: Path, *, flags: Sequence[str] = ()
+                     ) -> Optional[ctypes.CDLL]:
+    """Compile ``src`` (cached by content hash) and dlopen it; None on any
+    failure (missing source, no g++, compile error) with a warning."""
+    src = Path(src)
+    key = (str(src), tuple(flags))
+    if key in _cache:
+        return _cache[key]
+    lib = _build(src, tuple(flags))
+    _cache[key] = lib
+    return lib
+
+
+def _build(src: Path, flags) -> Optional[ctypes.CDLL]:
+    if not src.exists():
+        return None
+    digest = hashlib.sha256(src.read_bytes()
+                            + " ".join(flags).encode()).hexdigest()[:16]
+    out_dir = Path(tempfile.gettempdir()) / "dl4j_tpu_native"
+    out_dir.mkdir(parents=True, exist_ok=True)
+    so = out_dir / f"{src.stem}_{digest}.so"
+    if not so.exists():
+        # compile to a process-private name, then atomically rename: a
+        # second process must never dlopen a half-written .so
+        tmp = out_dir / f"{src.stem}_{digest}.{os.getpid()}.tmp.so"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++14",
+               *flags, "-o", str(tmp), str(src)]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        except Exception as e:
+            warnings.warn(f"native build of {src.name} failed ({e}); "
+                          "using the pure-Python fallback")
+            return None
+    try:
+        return ctypes.CDLL(str(so))
+    except OSError as e:
+        warnings.warn(f"loading {so.name} failed ({e}); "
+                      "using the pure-Python fallback")
+        return None
