@@ -23,7 +23,10 @@ TPU-native design — two modes, both expressed as XLA SPMD programs over a
 
 from .distributed import (global_mesh, host_local_batch, initialize,
                           is_initialized, process_count, process_index)
+from .expert import ExpertParallelTrainer
 from .mesh import create_mesh, data_parallel_mesh, mesh_devices
+from .pipeline import PipelineParallelTrainer
+from .tensor import TensorParallelTrainer
 from .training_master import (ParameterAveragingTrainingMaster,
                               SyncTrainingMaster, Trainer, TrainingMaster)
 from .wrapper import ParallelWrapper
@@ -32,4 +35,5 @@ __all__ = ["ParallelWrapper", "create_mesh", "data_parallel_mesh",
            "mesh_devices", "initialize", "is_initialized", "global_mesh",
            "host_local_batch", "process_count", "process_index",
            "TrainingMaster", "Trainer", "SyncTrainingMaster",
-           "ParameterAveragingTrainingMaster"]
+           "ParameterAveragingTrainingMaster", "TensorParallelTrainer",
+           "PipelineParallelTrainer", "ExpertParallelTrainer"]

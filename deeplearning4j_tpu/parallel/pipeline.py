@@ -1,0 +1,165 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+No reference analog (SURVEY §2.9: PP = NO) — north-star extension. Design
+is the standard TPU shift-register schedule (scaling-book style): a stack
+of S identical blocks, one per device along the ``pp`` axis, processes M
+microbatches in M+S-1 ticks; activations hop stage→stage over
+``lax.ppermute`` inside ``shard_map``, and autodiff through the permute
+gives exact pipeline-parallel gradients (the transpose of a shift forward
+is a shift backward). Stage parameters live only on their stage's device —
+memory scales 1/S, unlike a replicated fake pipeline.
+
+Scope: homogeneous stacks (every stage runs the same ``block_fn`` with its
+own parameters) — the shape pipeline parallelism is actually used for
+(transformer/MLP blocks). Heterogeneous stages belong to tensor/data
+parallelism or model surgery, not this schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import rng as _rng
+
+Pytree = Any
+BlockFn = Callable[[Pytree, jax.Array], jax.Array]
+
+
+def make_pipeline_forward(mesh: Mesh, axis: str, block_fn: BlockFn,
+                          n_stages: int, n_micro: int):
+    """Build ``fn(stacked_params, xm) -> ym``.
+
+    ``stacked_params``: pytree with leading stage axis [S, ...], sharded
+    over ``axis``. ``xm``: microbatched input [M, b, ...] (replicated).
+    Returns [M, b, ...] — the last stage's outputs, replicated.
+    """
+    if mesh.shape[axis] != n_stages:
+        raise ValueError(
+            f"mesh axis {axis!r} has size {mesh.shape[axis]}, "
+            f"need n_stages={n_stages}")
+    S, M = n_stages, n_micro
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def staged(params_blk, xm):
+        local = jax.tree_util.tree_map(lambda a: a[0], params_blk)
+        s = lax.axis_index(axis)
+
+        def tick(carry, t):
+            inflight, outs = carry
+            m_in = jnp.clip(t, 0, M - 1)
+            first = lax.dynamic_index_in_dim(xm, m_in, 0, keepdims=False)
+            x_in = jnp.where(s == 0, first, inflight)
+            y = block_fn(local, x_in)
+            nxt = lax.ppermute(y, axis, perm)
+            # the value reaching stage S-1 at tick t is microbatch t-(S-1);
+            # masked select (not lax.cond: branches would differ in
+            # mesh-variance type under shard_map's replication tracking)
+            m_out = jnp.clip(t - (S - 1), 0, M - 1)
+            write = jnp.logical_and(s == S - 1, t >= S - 1)
+            updated = lax.dynamic_update_index_in_dim(outs, y, m_out, 0)
+            outs = jnp.where(write, updated, outs)
+            return (nxt, outs), None
+
+        # carries become device-varying inside the loop (ppermute / masked
+        # writes), so their initial values must carry the same
+        # mesh-variance type
+        inflight0 = lax.pcast(jnp.zeros_like(xm[0]), axis, to="varying")
+        outs0 = lax.pcast(jnp.zeros_like(xm), axis, to="varying")
+        (_, outs), _ = lax.scan(tick, (inflight0, outs0),
+                                jnp.arange(M + S - 1))
+        # replicate the last stage's outputs to every device
+        return lax.psum(jnp.where(s == S - 1, outs, jnp.zeros_like(outs)),
+                        axis)
+
+    def fn(stacked_params, xm):
+        in_specs = (jax.tree_util.tree_map(lambda _: P(axis),
+                                           stacked_params), P())
+        return shard_map(staged, mesh=mesh, in_specs=in_specs,
+                         out_specs=P())(stacked_params, xm)
+
+    return fn
+
+
+class PipelineParallelTrainer:
+    """Train a stack of S identical blocks pipelined over ``axis``.
+
+    ``layer``: a framework layer config (e.g. ``DenseLayer(n_in=d, n_out=d)``)
+    whose ``apply(params, x, ...)`` is pure and shape-preserving; its
+    parameters are initialized per stage and stacked [S, ...]. The loss
+    head is a plain callable ``loss_fn(y, targets) -> scalar`` evaluated on
+    the final stage's (replicated) outputs.
+    """
+
+    def __init__(self, layer, n_stages: int, mesh: Mesh, *,
+                 axis: str = "pp", n_micro: Optional[int] = None,
+                 learning_rate: float = 0.01, loss: str = "mse",
+                 seed: int = 0, policy=None):
+        from .. import losses as _losses
+
+        self.layer = layer
+        self.mesh = mesh
+        self.axis = axis
+        self.S = int(n_stages)
+        self.M = int(n_micro if n_micro is not None else n_stages)
+        self.lr = float(learning_rate)
+
+        def block_fn(p, x):
+            y, _ = layer.apply(p, x, state=None, train=False, rng=None,
+                               policy=policy)
+            return y
+
+        # build first: validates n_stages against the mesh axis BEFORE any
+        # sharding (a mismatched device_put fails far less readably)
+        fwd = make_pipeline_forward(mesh, axis, block_fn, self.S, self.M)
+
+        key = _rng.key(seed)
+        per_stage = [layer.init_params(_rng.fold_name(key, f"stage_{i}"),
+                                       policy)
+                     for i in range(self.S)]
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *per_stage)
+        self.params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(axis, *([None] * (a.ndim - 1))))),
+            stacked)
+        loss_elem = _losses.get(loss)
+
+        def loss_fn(params, xm, ym):
+            out = fwd(params, xm)
+            per = loss_elem(ym, out, "identity")
+            return jnp.mean(per)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(params, xm, ym):
+            loss_val, grads = jax.value_and_grad(loss_fn)(params, xm, ym)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - self.lr * g, params, grads)
+            return params, loss_val
+
+        self._fwd = jax.jit(fwd)
+        self._step = step
+
+    def _microbatch(self, x) -> jax.Array:
+        x = jnp.asarray(x)
+        b = x.shape[0]
+        if b % self.M:
+            raise ValueError(f"batch {b} not divisible by n_micro={self.M}")
+        return x.reshape((self.M, b // self.M) + x.shape[1:])
+
+    def forward(self, x):
+        """Pipelined forward; returns [b, ...] on the host layout."""
+        ym = self._fwd(self.params, self._microbatch(x))
+        return ym.reshape((-1,) + ym.shape[2:])
+
+    def fit_batch(self, x, y) -> jax.Array:
+        xm = self._microbatch(x)
+        ym = self._microbatch(y)
+        self.params, loss = self._step(self.params, xm, ym)
+        return loss
