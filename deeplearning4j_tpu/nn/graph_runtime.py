@@ -152,6 +152,92 @@ class ComputationGraph:
             new_states[name] = st if st is not None else {}
         return acts, new_states
 
+    def _segment_plan(self):
+        """Partition the topo order into ~sqrt(V) segments and, per segment,
+        record which activations cross its boundary. Cached — the plan is
+        pure graph structure."""
+        plan = getattr(self, "_seg_plan", None)
+        if plan is not None:
+            return plan
+        order = self.topo_order
+        n_seg = max(1, int(np.ceil(np.sqrt(len(order)))))
+        bounds = np.array_split(np.arange(len(order)), n_seg)
+        pos = {name: i for i, name in enumerate(order)}
+        # the loss head reads the output-layer vertices' INPUTS (hidden
+        # activations feed compute_score_array), so those must be published
+        # as segment boundaries; output-layer vertices nothing downstream
+        # consumes are skipped entirely (their activation is never read —
+        # same rule as the unsegmented loss walk)
+        consumed = {i for ins in self.conf.vertex_inputs.values()
+                    for i in ins}
+        skip = {n for n in self._output_layer_names if n not in consumed}
+        required = set(self.conf.network_outputs) - skip
+        for name in self._output_layer_names:
+            required.update(self.conf.vertex_inputs[name])
+        segments = []
+        for idx in bounds:
+            seg = [order[i] for i in idx if order[i] not in skip]
+            if not seg:
+                continue
+            seg_set = set(seg)
+            ext_in, seen = [], set()
+            for vname in seg:
+                for src in self.conf.vertex_inputs[vname]:
+                    if src not in seg_set and src not in seen:
+                        seen.add(src)
+                        ext_in.append(src)
+            last = pos[seg[-1]]
+            outs = [vname for vname in seg
+                    if vname in required
+                    or any(pos[w] > last
+                           for w in order
+                           if vname in self.conf.vertex_inputs[w])]
+            segments.append((seg, ext_in, outs))
+        self._seg_plan = (segments, skip)
+        return self._seg_plan
+
+    def _forward_segmented(self, params, states, inputs: List[jax.Array],
+                           *, rng=None):
+        """Training forward with segment-level rematerialization: only
+        segment-boundary activations stay live for the backward pass; each
+        segment's interior (conv pre-activations, BN intermediates, ...) is
+        recomputed under ``jax.checkpoint``. ~sqrt(V) segments — the
+        standard memory/compute trade (brief: jax.checkpoint for HBM).
+        Masked inputs fall back to the unsegmented path (mask plumbing is
+        host-side Python, incompatible with a traced segment boundary)."""
+        acts: Dict[str, jax.Array] = dict(
+            zip(self.conf.network_inputs, inputs))
+        segments, skip = self._segment_plan()
+        # skipped (unconsumed) output-layer vertices still need a state
+        # entry: downstream carry structures index every vertex name
+        new_states: Dict[str, Dict[str, jax.Array]] = {n: {} for n in skip}
+        for seg, ext_in, outs_needed in segments:
+            seg_params = {n: params[n] for n in seg}
+            seg_states = {n: states[n] for n in seg}
+            seg_rngs = {n: (None if rng is None else _rng.fold_name(rng, n))
+                        for n in seg}
+
+            def seg_fn(p, ext_acts, st, rngs, _seg=tuple(seg),
+                       _ext=tuple(ext_in), _outs=tuple(outs_needed)):
+                local = dict(zip(_ext, ext_acts))
+                st_out = {}
+                for vname in _seg:
+                    v = self.conf.vertices[vname]
+                    xs = [local[i] for i in self.conf.vertex_inputs[vname]]
+                    out, vst = v.apply(p[vname], xs, state=st[vname],
+                                       train=True, rng=rngs[vname],
+                                       masks=[None] * len(xs),
+                                       policy=self.policy)
+                    local[vname] = out
+                    st_out[vname] = vst if vst is not None else {}
+                return [local[o] for o in _outs], st_out
+
+            outs, seg_new = jax.checkpoint(seg_fn)(
+                seg_params, [acts[n] for n in ext_in], seg_states, seg_rngs)
+            acts.update(zip(outs_needed, outs))
+            new_states.update(seg_new)
+        return acts, new_states
+
     # ------------------------------------------------------------------
     # inference (parity: output :1058)
     # ------------------------------------------------------------------
@@ -196,6 +282,18 @@ class ComputationGraph:
             raise ValueError(
                 "no output vertex has a loss (need OutputLayer/RnnOutputLayer/"
                 "LossLayer at a network output to train)")
+        if self.training.gradient_checkpointing:
+            if masks is None or all(m is None for m in masks):
+                return self._loss_fn_segmented(params, states, inputs,
+                                               labels, rng)
+            # masked graphs keep the unsegmented walk (mask bookkeeping is
+            # per-vertex host-side state across segment boundaries) — say
+            # so loudly rather than silently dropping the memory saving
+            import warnings
+            warnings.warn(
+                "gradient_checkpointing is ignored for masked "
+                "ComputationGraph inputs — the full-activation path runs",
+                stacklevel=2)
         # forward everything EXCEPT the output-layer vertices' own apply;
         # for those we need the hidden input to compute_score_array
         out_set = set(self._output_layer_names)
@@ -210,7 +308,6 @@ class ComputationGraph:
         # layers with consumers); XLA CSE merges the duplicated layer forward
         consumed = {i for ins in self.conf.vertex_inputs.values() for i in ins}
         total = 0.0
-        denom_total = 0.0
         for name in self.topo_order:
             v = self.conf.vertices[name]
             in_names = self.conf.vertex_inputs[name]
@@ -218,20 +315,9 @@ class ComputationGraph:
             in_masks = [mask_map.get(i) for i in in_names]
             vrng = None if rng is None else _rng.fold_name(rng, name)
             if name in out_set:
-                layer = v.layer
-                hidden = xs[0]
-                out_mask = in_masks[0] if in_masks else None
-                if v.preprocessor is not None:
-                    mb = hidden.shape[0]
-                    hidden = v.preprocessor(hidden, minibatch_size=mb)
-                    out_mask = v.preprocessor.transform_mask(
-                        out_mask, minibatch_size=mb)
-                y = label_map[name]
-                score_arr = layer.compute_score_array(
-                    params[name], hidden, y, mask=out_mask, policy=self.policy)
-                denom = _losses.masked_denominator(out_mask, y,
-                                                  score_arr.shape[0])
-                total = total + jnp.sum(score_arr) / denom
+                total = total + self._output_score(
+                    params, name, xs[0], label_map[name],
+                    in_masks[0] if in_masks else None)
                 if name in consumed:
                     out, st = v.apply(params[name], xs, state=states[name],
                                       train=True, rng=vrng, masks=in_masks,
@@ -250,6 +336,40 @@ class ComputationGraph:
                 mask_map[name] = v.output_mask(in_masks,
                                                minibatch=xs[0].shape[0])
                 new_states[name] = st if st is not None else {}
+        total = total + self._reg_penalty(params)
+        loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
+                      else jnp.float32)
+        return total.astype(loss_dtype), new_states
+
+    def _output_score(self, params, name, hidden, y, mask):
+        """One output vertex's loss contribution from its HIDDEN input —
+        preprocessor, fused score array, masked denominator. Shared by the
+        plain and gradient-checkpointed loss paths."""
+        v = self.conf.vertices[name]
+        out_mask = mask
+        if v.preprocessor is not None:
+            mb = hidden.shape[0]
+            hidden = v.preprocessor(hidden, minibatch_size=mb)
+            out_mask = v.preprocessor.transform_mask(out_mask,
+                                                     minibatch_size=mb)
+        score_arr = v.layer.compute_score_array(
+            params[name], hidden, y, mask=out_mask, policy=self.policy)
+        denom = _losses.masked_denominator(out_mask, y, score_arr.shape[0])
+        return jnp.sum(score_arr) / denom
+
+    def _loss_fn_segmented(self, params, states, inputs, labels, rng):
+        """Gradient-checkpointed loss: the DAG runs through
+        ``_forward_segmented`` (only ~sqrt(V) boundary activations stay
+        live for the backward), then the loss heads score the published
+        hidden activations exactly like the unsegmented path."""
+        acts, new_states = self._forward_segmented(params, states, inputs,
+                                                   rng=rng)
+        label_map = dict(zip(self.conf.network_outputs, labels))
+        total = 0.0
+        for name in self._output_layer_names:
+            hidden = acts[self.conf.vertex_inputs[name][0]]
+            total = total + self._output_score(params, name, hidden,
+                                               label_map[name], None)
         total = total + self._reg_penalty(params)
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)

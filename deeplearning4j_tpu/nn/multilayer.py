@@ -143,6 +143,9 @@ class MultiLayerNetwork:
         the same dicts when present (TBPTT / rnnTimeStep).
         """
         upto = len(self.layers) if upto is None else upto
+        if self.training.gradient_checkpointing and train and not collect:
+            return self._forward_segmented(params, states, x, rng=rng,
+                                           mask=mask, upto=upto)
         minibatch = x.shape[0]
         cur, cur_mask = x, mask
         acts = [x] if collect else None
@@ -164,6 +167,45 @@ class MultiLayerNetwork:
             if collect:
                 acts.append(cur)
         return (acts if collect else cur), new_states
+
+    def _forward_segmented(self, params, states, x, *, rng=None, mask=None,
+                           upto: Optional[int] = None):
+        """Training forward with SEGMENT-level rematerialization: layers are
+        grouped into ~sqrt(N) runs and each run re-executes under
+        ``jax.checkpoint`` in the backward — only segment-boundary
+        activations stay live (per-layer checkpointing would keep every
+        layer output as a residual and save almost nothing)."""
+        n = len(self.layers) if upto is None else upto
+        n_seg = max(1, int(np.ceil(np.sqrt(max(n, 1)))))
+        minibatch = x.shape[0]
+        cur, cur_mask = x, mask
+        new_states: List[Dict] = []
+        for idx in np.array_split(np.arange(n), n_seg):
+            seg = [int(i) for i in idx]
+            seg_params = [params[_layer_key(i)] for i in seg]
+            seg_states = [states[i] for i in seg]
+            seg_rngs = [None if rng is None
+                        else _rng.fold_name(rng, _layer_key(i)) for i in seg]
+
+            def seg_fn(p_seg, cur, cur_mask, st_seg, rngs, _seg=tuple(seg)):
+                st_out = []
+                for j, i in enumerate(_seg):
+                    proc = self.conf.input_preprocessors.get(i)
+                    if proc is not None:
+                        cur = proc(cur, minibatch_size=minibatch)
+                        cur_mask = proc.transform_mask(
+                            cur_mask, minibatch_size=minibatch)
+                    cur, st = self.layers[i].apply(
+                        p_seg[j], cur, state=st_seg[j], train=True,
+                        rng=rngs[j], mask=cur_mask, policy=self.policy)
+                    st_out.append(st if st is not None else {})
+                return cur, cur_mask, st_out
+
+            cur, cur_mask, st_out = jax.checkpoint(seg_fn)(
+                seg_params, cur, cur_mask, seg_states, seg_rngs)
+            new_states.extend(st_out)
+        new_states.extend(states[n:])   # layers beyond upto: untouched
+        return cur, new_states
 
     def _states_list(self, rnn_state=None):
         out = []
