@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 from .inputs import InputType
 from .layers import Layer, layer_from_dict, layer_to_dict
-from .preprocessors import InputPreProcessor, preprocessor_from_dict
+from .preprocessors import (InputPreProcessor, call_preprocessor,
+                            preprocessor_from_dict)
 from .training import TrainingConfig
 
 # ensure recurrent layer types are registered for serde
@@ -169,7 +170,8 @@ class LayerVertex(GraphVertex):
         mask = masks[0] if masks else None
         if self.preprocessor is not None:
             mb = x.shape[0]
-            x = self.preprocessor(x, minibatch_size=mb)
+            x = call_preprocessor(self.preprocessor, x, minibatch_size=mb,
+                                  rng=rng)
             mask = self.preprocessor.transform_mask(mask, minibatch_size=mb)
         return self.layer.apply(params, x, state=state, train=train, rng=rng,
                                 mask=mask, policy=policy)

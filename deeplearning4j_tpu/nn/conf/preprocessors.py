@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional, Type
 
+import jax
 import jax.numpy as jnp
 
 from .inputs import InputType
@@ -35,9 +36,24 @@ def register_preprocessor(name: str):
     return deco
 
 
+def call_preprocessor(proc: "InputPreProcessor", x, minibatch_size=None,
+                      rng=None):
+    """Invoke a preprocessor from a network runtime — the ONE place that
+    threads the per-layer rng into preprocessors declaring ``wants_rng``
+    (stochastic samplers get a fresh fold of the step key; everything else
+    keeps the plain pure-reshape call)."""
+    if getattr(proc, "wants_rng", False) and rng is not None:
+        from ...rng import fold_name
+        return proc(x, minibatch_size=minibatch_size,
+                    key=fold_name(rng, "preproc"))
+    return proc(x, minibatch_size=minibatch_size)
+
+
 def preprocessor_from_dict(d) -> "InputPreProcessor":
     d = dict(d)
     typ = d.pop("type")
+    if "children" in d:  # ComposableInputPreProcessor: nested serde
+        d["children"] = tuple(preprocessor_from_dict(c) for c in d["children"])
     return _REGISTRY[typ](**d)
 
 
@@ -163,3 +179,151 @@ class RnnToCnnPreProcessor(InputPreProcessor):
 
     def output_type(self, input_type):
         return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor("reshape")
+@dataclasses.dataclass(frozen=True)
+class ReshapePreProcessor(InputPreProcessor):
+    """Arbitrary reshape (parity: ``ReshapePreProcessor.java:67`` — with
+    ``dynamic=True`` the leading dim follows the incoming minibatch).
+
+    The reference also stores ``fromShape`` for its hand-written backprop;
+    autodiff derives the inverse reshape here, so only ``to_shape`` is kept.
+    """
+    to_shape: tuple = ()
+    dynamic: bool = True
+
+    def __call__(self, x, minibatch_size=None):
+        shape = tuple(int(s) for s in self.to_shape)
+        if self.dynamic:
+            shape = (x.shape[0],) + shape[1:]
+        return x.reshape(shape)
+
+    def output_type(self, input_type):
+        tail = tuple(int(s) for s in self.to_shape[1:])
+        if len(tail) == 1:
+            return InputType.feed_forward(tail[0])
+        if len(tail) == 2:
+            return InputType.recurrent(tail[1])
+        if len(tail) == 3:
+            return InputType.convolutional(*tail)
+        raise ValueError(f"cannot infer InputType for to_shape={self.to_shape}")
+
+    def to_dict(self):
+        return {"type": self._type_name,
+                "to_shape": list(self.to_shape), "dynamic": self.dynamic}
+
+
+@register_preprocessor("zero_mean")
+@dataclasses.dataclass(frozen=True)
+class ZeroMeanPreProcessor(InputPreProcessor):
+    """Subtract per-column batch mean (parity: ``ZeroMeanPrePreProcessor``).
+
+    The reference's ``backprop`` passes cotangents through unchanged, i.e.
+    it treats the batch statistic as a constant; ``stop_gradient`` on the
+    mean reproduces exactly that.
+    """
+
+    def __call__(self, x, minibatch_size=None):
+        import jax
+        return x - jax.lax.stop_gradient(x.mean(axis=0, keepdims=True))
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor("unit_variance")
+@dataclasses.dataclass(frozen=True)
+class UnitVarianceProcessor(InputPreProcessor):
+    """Divide by per-column batch std (parity: ``UnitVarianceProcessor.java:39``,
+    incl. the reference's ddof=1 ``std`` and epsilon guard)."""
+    eps: float = 1e-5
+
+    def __call__(self, x, minibatch_size=None):
+        import jax
+        std = jnp.std(x, axis=0, keepdims=True, ddof=1) + self.eps
+        return x / jax.lax.stop_gradient(std)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor("zero_mean_unit_variance")
+@dataclasses.dataclass(frozen=True)
+class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
+    """Column-standardize activations (parity:
+    ``ZeroMeanAndUnitVariancePreProcessor.java:38``)."""
+    eps: float = 1e-5
+
+    def __call__(self, x, minibatch_size=None):
+        import jax
+        mean = x.mean(axis=0, keepdims=True)
+        std = jnp.std(x, axis=0, keepdims=True, ddof=1) + self.eps
+        return (x - jax.lax.stop_gradient(mean)) / jax.lax.stop_gradient(std)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor("binomial_sampling")
+@dataclasses.dataclass(frozen=True)
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Bernoulli-sample activations as probabilities (parity:
+    ``BinomialSamplingPreProcessor.java:36`` — the RBM-era stochastic
+    binarization).
+
+    Functional RNG: the network runtimes see ``wants_rng`` and pass the
+    per-layer fold of the step rng as ``key=`` — fresh samples every
+    training step, like the reference's global-RNG draw. Only a direct
+    call with no ``key=`` falls back to the deterministic seed-derived key.
+    Backward is straight-through (sampling has no gradient), matching the
+    reference's identity ``backprop``.
+    """
+    seed: int = 0
+    wants_rng = True
+
+    def __call__(self, x, minibatch_size=None, key=None):
+        import jax
+        if key is None:
+            key = jax.random.PRNGKey(self.seed)
+        sample = jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
+        return x + jax.lax.stop_gradient(sample - x)  # straight-through
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor("composable")
+@dataclasses.dataclass(frozen=True)
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain preprocessors in order (parity:
+    ``ComposableInputPreProcessor.java:43``; the reference's reversed
+    backprop order falls out of autodiff)."""
+    children: tuple = ()
+
+    @property
+    def wants_rng(self):
+        return any(getattr(p, "wants_rng", False) for p in self.children)
+
+    def __call__(self, x, minibatch_size=None, key=None):
+        for i, p in enumerate(self.children):
+            if getattr(p, "wants_rng", False) and key is not None:
+                x = p(x, minibatch_size=minibatch_size,
+                      key=jax.random.fold_in(key, i))
+            else:
+                x = p(x, minibatch_size=minibatch_size)
+        return x
+
+    def transform_mask(self, mask, minibatch_size=None):
+        for p in self.children:
+            mask = p.transform_mask(mask, minibatch_size=minibatch_size)
+        return mask
+
+    def output_type(self, input_type):
+        for p in self.children:
+            input_type = p.output_type(input_type)
+        return input_type
+
+    def to_dict(self):
+        return {"type": self._type_name,
+                "children": [p.to_dict() for p in self.children]}

@@ -139,18 +139,30 @@ class ComputationGraph:
                 masks if masks is not None else [None] * len(inputs)))
         new_states: Dict[str, Dict[str, jax.Array]] = {}
         for name in self.topo_order:
-            v = self.conf.vertices[name]
             in_names = self.conf.vertex_inputs[name]
-            xs = [acts[i] for i in in_names]
             in_masks = [mask_map.get(i) for i in in_names]
             vrng = None if rng is None else _rng.fold_name(rng, name)
-            out, st = v.apply(params[name], xs, state=states[name],
-                              train=train, rng=vrng, masks=in_masks,
-                              policy=self.policy)
+            out, st = self._apply_vertex(name, params[name], acts,
+                                         states[name], vrng, train=train,
+                                         in_masks=in_masks)
             acts[name] = out
-            mask_map[name] = v.output_mask(in_masks, minibatch=xs[0].shape[0])
-            new_states[name] = st if st is not None else {}
+            mask_map[name] = self.conf.vertices[name].output_mask(
+                in_masks, minibatch=acts[in_names[0]].shape[0])
+            new_states[name] = st
         return acts, new_states
+
+    def _apply_vertex(self, name, params_n, local_acts, state_n, vrng, *,
+                      train, in_masks=None):
+        """Gather inputs + apply for one vertex — the single definition of
+        per-vertex forward semantics, shared by the plain and
+        remat-segmented paths (so they cannot drift)."""
+        v = self.conf.vertices[name]
+        xs = [local_acts[i] for i in self.conf.vertex_inputs[name]]
+        if in_masks is None:
+            in_masks = [None] * len(xs)
+        out, st = v.apply(params_n, xs, state=state_n, train=train,
+                          rng=vrng, masks=in_masks, policy=self.policy)
+        return out, (st if st is not None else {})
 
     def _segment_plan(self):
         """Partition the topo order into ~sqrt(V) segments and, per segment,
@@ -222,14 +234,11 @@ class ComputationGraph:
                 local = dict(zip(_ext, ext_acts))
                 st_out = {}
                 for vname in _seg:
-                    v = self.conf.vertices[vname]
-                    xs = [local[i] for i in self.conf.vertex_inputs[vname]]
-                    out, vst = v.apply(p[vname], xs, state=st[vname],
-                                       train=True, rng=rngs[vname],
-                                       masks=[None] * len(xs),
-                                       policy=self.policy)
+                    out, vst = self._apply_vertex(
+                        vname, p[vname], local, st[vname], rngs[vname],
+                        train=True)
                     local[vname] = out
-                    st_out[vname] = vst if vst is not None else {}
+                    st_out[vname] = vst
                 return [local[o] for o in _outs], st_out
 
             outs, seg_new = jax.checkpoint(seg_fn)(

@@ -42,6 +42,7 @@ from .. import rng as _rng
 from ..optimize import updaters as _updaters
 from ..util import xla as _xla
 from .conf.multi_layer import MultiLayerConfiguration
+from .conf.preprocessors import call_preprocessor
 
 Pytree = Any
 
@@ -154,19 +155,29 @@ class MultiLayerNetwork:
             if i >= upto:
                 new_states.append(states[i])
                 continue
-            layer = self.layers[i]
-            proc = self.conf.input_preprocessors.get(i)
-            if proc is not None:
-                cur = proc(cur, minibatch_size=minibatch)
-                cur_mask = proc.transform_mask(cur_mask, minibatch_size=minibatch)
             lrng = None if rng is None else _rng.fold_name(rng, _layer_key(i))
-            cur, st = layer.apply(params[_layer_key(i)], cur,
-                                  state=states[i], train=train, rng=lrng,
-                                  mask=cur_mask, policy=self.policy)
-            new_states.append(st if st is not None else {})
+            cur, cur_mask, st = self._apply_layer(
+                i, params[_layer_key(i)], cur, cur_mask, states[i], lrng,
+                train=train, minibatch=minibatch)
+            new_states.append(st)
             if collect:
                 acts.append(cur)
         return (acts if collect else cur), new_states
+
+    def _apply_layer(self, i, p_i, cur, cur_mask, state_i, lrng, *,
+                     train, minibatch):
+        """Preprocessor + apply at layer position ``i`` — the single
+        definition of per-layer forward semantics, shared by the plain and
+        remat-segmented paths (so they cannot drift)."""
+        proc = self.conf.input_preprocessors.get(i)
+        if proc is not None:
+            cur = call_preprocessor(proc, cur, minibatch_size=minibatch,
+                                    rng=lrng)
+            cur_mask = proc.transform_mask(cur_mask, minibatch_size=minibatch)
+        cur, st = self.layers[i].apply(p_i, cur, state=state_i, train=train,
+                                       rng=lrng, mask=cur_mask,
+                                       policy=self.policy)
+        return cur, cur_mask, (st if st is not None else {})
 
     def _forward_segmented(self, params, states, x, *, rng=None, mask=None,
                            upto: Optional[int] = None):
@@ -190,15 +201,10 @@ class MultiLayerNetwork:
             def seg_fn(p_seg, cur, cur_mask, st_seg, rngs, _seg=tuple(seg)):
                 st_out = []
                 for j, i in enumerate(_seg):
-                    proc = self.conf.input_preprocessors.get(i)
-                    if proc is not None:
-                        cur = proc(cur, minibatch_size=minibatch)
-                        cur_mask = proc.transform_mask(
-                            cur_mask, minibatch_size=minibatch)
-                    cur, st = self.layers[i].apply(
-                        p_seg[j], cur, state=st_seg[j], train=True,
-                        rng=rngs[j], mask=cur_mask, policy=self.policy)
-                    st_out.append(st if st is not None else {})
+                    cur, cur_mask, st = self._apply_layer(
+                        i, p_seg[j], cur, cur_mask, st_seg[j], rngs[j],
+                        train=True, minibatch=minibatch)
+                    st_out.append(st)
                 return cur, cur_mask, st_out
 
             cur, cur_mask, st_out = jax.checkpoint(seg_fn)(

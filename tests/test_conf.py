@@ -99,3 +99,112 @@ def test_yaml_roundtrip():
         return  # yaml not available in this image; JSON path is canonical
     conf2 = MultiLayerConfiguration.from_yaml(y)
     assert conf2.to_json() == conf.to_json()
+
+
+class TestAuxPreprocessors:
+    """The six non-shape preprocessors (reshape/normalize/sample/compose) —
+    parity: reference nn/conf/preprocessor/ beyond the 6 shape adapters."""
+
+    def test_reshape_dynamic_and_serde(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            ReshapePreProcessor, preprocessor_from_dict)
+        p = ReshapePreProcessor(to_shape=(0, 4, 4, 2), dynamic=True)
+        x = np.arange(3 * 32, dtype=np.float32).reshape(3, 32)
+        out = p(x)
+        assert out.shape == (3, 4, 4, 2)
+        rt = preprocessor_from_dict(p.to_dict())
+        assert np.allclose(rt(x), out)
+        it = p.output_type(InputType.feed_forward(32))
+        assert (it.height, it.width, it.channels) == (4, 4, 2)
+
+    def test_zero_mean_unit_variance(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            ZeroMeanPreProcessor, UnitVarianceProcessor,
+            ZeroMeanAndUnitVariancePreProcessor)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 7).astype(np.float32) * 3 + 5
+        zm = np.asarray(ZeroMeanPreProcessor()(x))
+        assert np.allclose(zm.mean(axis=0), 0.0, atol=1e-5)
+        uv = np.asarray(UnitVarianceProcessor()(x))
+        assert np.allclose(uv.std(axis=0, ddof=1), 1.0, atol=1e-2)
+        zmuv = np.asarray(ZeroMeanAndUnitVariancePreProcessor()(x))
+        assert np.allclose(zmuv.mean(axis=0), 0.0, atol=1e-5)
+        assert np.allclose(zmuv.std(axis=0, ddof=1), 1.0, atol=1e-2)
+
+    def test_normalizers_stop_gradient_matches_ref_backprop(self):
+        # reference backprop() is identity: batch stats are constants.
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf.preprocessors import ZeroMeanPreProcessor
+        p = ZeroMeanPreProcessor()
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 3).astype(np.float32))
+        g = jax.grad(lambda a: p(a).sum())(x)
+        assert np.allclose(np.asarray(g), 1.0)  # d(x - const)/dx = 1
+
+    def test_binomial_sampling(self):
+        import jax
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            BinomialSamplingPreProcessor)
+        p = BinomialSamplingPreProcessor(seed=7)
+        x = np.full((2000,), 0.3, dtype=np.float32)
+        s = np.asarray(p(x, key=jax.random.PRNGKey(3)))
+        assert set(np.unique(s)) <= {0.0, 1.0}
+        assert abs(s.mean() - 0.3) < 0.05
+        # straight-through gradient
+        import jax.numpy as jnp
+        g = jax.grad(lambda a: p(jnp.asarray(a)).sum())(jnp.asarray(x))
+        assert np.allclose(np.asarray(g), 1.0)
+
+    def test_composable_chain_and_serde(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            ComposableInputPreProcessor, ZeroMeanPreProcessor,
+            ReshapePreProcessor, preprocessor_from_dict)
+        p = ComposableInputPreProcessor(children=(
+            ZeroMeanPreProcessor(), ReshapePreProcessor(to_shape=(0, 2, 8, 1))))
+        x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+        out = np.asarray(p(x))
+        assert out.shape == (4, 2, 8, 1)
+        assert abs(out.mean()) < 1e-5
+        rt = preprocessor_from_dict(p.to_dict())
+        assert np.allclose(np.asarray(rt(x)), out)
+        it = p.output_type(InputType.feed_forward(16))
+        assert (it.height, it.width, it.channels) == (2, 8, 1)
+
+    def test_binomial_preproc_runtime_threads_fresh_rng(self):
+        # the network runtime must hand the per-step rng to wants_rng
+        # preprocessors: different step keys -> different samples,
+        # same key -> identical (pure-function reproducibility)
+        import jax
+        from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            BinomialSamplingPreProcessor)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .list()
+                .layer(ActivationLayer(activation="identity"))
+                .input_preprocessor(0, BinomialSamplingPreProcessor())
+                .set_input_type(InputType.feed_forward(64))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.full((8, 64), 0.5, dtype=np.float32)
+        run = lambda k: np.asarray(net._forward(
+            net.params, net._states_list(), x, train=True,
+            rng=jax.random.PRNGKey(k))[0])
+        a, b, a2 = run(0), run(1), run(0)
+        assert not np.allclose(a, b)
+        assert np.allclose(a, a2)
+
+    def test_composable_propagates_rng_to_sampler_children(self):
+        import jax
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            BinomialSamplingPreProcessor, ComposableInputPreProcessor,
+            ZeroMeanPreProcessor, call_preprocessor)
+        p = ComposableInputPreProcessor(children=(
+            BinomialSamplingPreProcessor(), ZeroMeanPreProcessor()))
+        assert p.wants_rng  # surfaces children's need to the runtimes
+        x = np.full((8, 64), 0.5, dtype=np.float32)
+        a = np.asarray(call_preprocessor(p, x, rng=jax.random.PRNGKey(0)))
+        b = np.asarray(call_preprocessor(p, x, rng=jax.random.PRNGKey(1)))
+        a2 = np.asarray(call_preprocessor(p, x, rng=jax.random.PRNGKey(0)))
+        assert not np.allclose(a, b)
+        assert np.allclose(a, a2)
