@@ -225,7 +225,6 @@ class ZeroMeanPreProcessor(InputPreProcessor):
     """
 
     def __call__(self, x, minibatch_size=None):
-        import jax
         return x - jax.lax.stop_gradient(x.mean(axis=0, keepdims=True))
 
     def output_type(self, input_type):
@@ -240,7 +239,6 @@ class UnitVarianceProcessor(InputPreProcessor):
     eps: float = 1e-5
 
     def __call__(self, x, minibatch_size=None):
-        import jax
         std = jnp.std(x, axis=0, keepdims=True, ddof=1) + self.eps
         return x / jax.lax.stop_gradient(std)
 
@@ -256,7 +254,6 @@ class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
     eps: float = 1e-5
 
     def __call__(self, x, minibatch_size=None):
-        import jax
         mean = x.mean(axis=0, keepdims=True)
         std = jnp.std(x, axis=0, keepdims=True, ddof=1) + self.eps
         return (x - jax.lax.stop_gradient(mean)) / jax.lax.stop_gradient(std)
@@ -283,7 +280,6 @@ class BinomialSamplingPreProcessor(InputPreProcessor):
     wants_rng = True
 
     def __call__(self, x, minibatch_size=None, key=None):
-        import jax
         if key is None:
             key = jax.random.PRNGKey(self.seed)
         sample = jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)

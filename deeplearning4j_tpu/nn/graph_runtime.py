@@ -27,6 +27,7 @@ from .. import rng as _rng
 from ..optimize import updaters as _updaters
 from ..util import xla as _xla
 from .conf.graph import ComputationGraphConfiguration, LayerVertex
+from .conf.preprocessors import call_preprocessor
 
 Pytree = Any
 
@@ -318,47 +319,42 @@ class ComputationGraph:
         consumed = {i for ins in self.conf.vertex_inputs.values() for i in ins}
         total = 0.0
         for name in self.topo_order:
-            v = self.conf.vertices[name]
             in_names = self.conf.vertex_inputs[name]
-            xs = [acts[i] for i in in_names]
             in_masks = [mask_map.get(i) for i in in_names]
             vrng = None if rng is None else _rng.fold_name(rng, name)
-            if name in out_set:
+            is_out = name in out_set
+            if is_out:
                 total = total + self._output_score(
-                    params, name, xs[0], label_map[name],
-                    in_masks[0] if in_masks else None)
-                if name in consumed:
-                    out, st = v.apply(params[name], xs, state=states[name],
-                                      train=True, rng=vrng, masks=in_masks,
-                                      policy=self.policy)
-                    acts[name] = out
-                    mask_map[name] = v.output_mask(
-                        in_masks, minibatch=xs[0].shape[0])
-                    new_states[name] = st if st is not None else {}
-                else:
-                    new_states[name] = {}
-            else:
-                out, st = v.apply(params[name], xs, state=states[name],
-                                  train=True, rng=vrng, masks=in_masks,
-                                  policy=self.policy)
+                    params, name, acts[in_names[0]], label_map[name],
+                    in_masks[0] if in_masks else None, vrng)
+            if not is_out or name in consumed:
+                out, st = self._apply_vertex(name, params[name], acts,
+                                             states[name], vrng, train=True,
+                                             in_masks=in_masks)
                 acts[name] = out
-                mask_map[name] = v.output_mask(in_masks,
-                                               minibatch=xs[0].shape[0])
-                new_states[name] = st if st is not None else {}
+                mask_map[name] = self.conf.vertices[name].output_mask(
+                    in_masks, minibatch=acts[in_names[0]].shape[0])
+                new_states[name] = st
+            else:
+                new_states[name] = {}
         total = total + self._reg_penalty(params)
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)
         return total.astype(loss_dtype), new_states
 
-    def _output_score(self, params, name, hidden, y, mask):
+    def _output_score(self, params, name, hidden, y, mask, vrng=None):
         """One output vertex's loss contribution from its HIDDEN input —
         preprocessor, fused score array, masked denominator. Shared by the
-        plain and gradient-checkpointed loss paths."""
+        plain and gradient-checkpointed loss paths. ``vrng`` is this
+        vertex's rng fold — the SAME one ``_apply_vertex`` uses, so a
+        sampling preprocessor on a consumed output vertex draws one sample,
+        not two different ones."""
         v = self.conf.vertices[name]
         out_mask = mask
         if v.preprocessor is not None:
             mb = hidden.shape[0]
-            hidden = v.preprocessor(hidden, minibatch_size=mb)
+            hidden = call_preprocessor(v.preprocessor, hidden,
+                                       minibatch_size=mb, rng=vrng)
             out_mask = v.preprocessor.transform_mask(out_mask,
                                                      minibatch_size=mb)
         score_arr = v.layer.compute_score_array(
@@ -377,8 +373,9 @@ class ComputationGraph:
         total = 0.0
         for name in self._output_layer_names:
             hidden = acts[self.conf.vertex_inputs[name][0]]
+            vrng = None if rng is None else _rng.fold_name(rng, name)
             total = total + self._output_score(params, name, hidden,
-                                               label_map[name], None)
+                                               label_map[name], None, vrng)
         total = total + self._reg_penalty(params)
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)

@@ -320,7 +320,10 @@ class MultiLayerNetwork:
         proc = self.conf.input_preprocessors.get(out_idx)
         out_mask = mask
         if proc is not None:
-            hidden = proc(hidden, minibatch_size=x.shape[0])
+            lrng = None if rng is None else _rng.fold_name(rng,
+                                                           _layer_key(out_idx))
+            hidden = call_preprocessor(proc, hidden,
+                                       minibatch_size=x.shape[0], rng=lrng)
             out_mask = proc.transform_mask(out_mask, minibatch_size=x.shape[0])
         score_arr = out_layer.compute_score_array(
             params[_layer_key(out_idx)], hidden, y, mask=out_mask,

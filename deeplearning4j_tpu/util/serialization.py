@@ -79,6 +79,10 @@ def _flatten(prefix: str, tree: Pytree, out: Dict[str, np.ndarray]) -> None:
 
 def _unflatten(entries: Dict[str, np.ndarray]) -> Pytree:
     """Rebuild the nested structure from path-keyed arrays."""
+    return _materialize(_nest(entries))
+
+
+def _nest(entries: Dict[str, np.ndarray]) -> Dict[str, Any]:
     root: Dict[str, Any] = {}
     for path, arr in entries.items():
         parts = path.split("/")
@@ -86,7 +90,61 @@ def _unflatten(entries: Dict[str, np.ndarray]) -> Pytree:
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         node[parts[-1]] = arr
-    return _materialize(root)
+    return root
+
+
+def _unflatten_like(template: Pytree, node: Any, path: str = "",
+                    strict: bool = True) -> Pytree:
+    """Rebuild a pytree with the TEMPLATE's structure from the raw nested
+    path-dict. ``_flatten`` stores only leaves, so containers with none
+    (param-less layers' ``{}`` params, empty per-layer state dicts) leave no
+    trace in the npz — a purely positional rebuild would drop dict keys and,
+    worse, silently left-shift list entries. The template (a freshly
+    ``init()``-ed net, same config) supplies the true structure; stored
+    arrays fill its leaves.
+
+    ``strict=True`` (params): stored keys outside the template are a config
+    mismatch. ``strict=False`` (layer state): extra stored keys are kept —
+    state dicts legitimately grow at runtime (rnn carries etc.), so the
+    init() template is a floor, not the full schema."""
+    if isinstance(template, dict):
+        node = node if isinstance(node, dict) else {}
+        extra = set(node) - {str(k) for k in template}
+        if extra and strict:
+            raise ValueError(
+                f"checkpoint has entries not in the model at '{path}': "
+                f"{sorted(extra)} — config mismatch?")
+        out = {k: _unflatten_like(tv, node.get(str(k)), f"{path}/{k}", strict)
+               for k, tv in template.items()}
+        for k in sorted(extra):
+            out[k] = _materialize(node[k])
+        return out
+    if isinstance(template, (list, tuple)):
+        node = node if isinstance(node, dict) else {}
+        seq = [_unflatten_like(tv, node.get(f"L{i}", node.get(f"T{i}")),
+                               f"{path}/{i}", strict)
+               for i, tv in enumerate(template)]
+        extra_idx = [k for k in node
+                     if k[:1] in ("L", "T") and k[1:].isdigit()
+                     and int(k[1:]) >= len(template)]
+        if extra_idx:
+            if strict:
+                raise ValueError(
+                    f"checkpoint has entries beyond the model's "
+                    f"{len(template)} at '{path}': {sorted(extra_idx)} — "
+                    "config mismatch?")
+            seq.extend(_materialize(node[k]) for k in
+                       sorted(extra_idx, key=lambda k: int(k[1:])))
+        return tuple(seq) if isinstance(template, tuple) else seq
+    if node is None:
+        if not strict:
+            # lenient (state): a leaf the checkpoint predates keeps its
+            # init() value — old checkpoints stay loadable when a layer
+            # grows new state
+            return template
+        raise ValueError(f"checkpoint is missing array for '{path}' — "
+                         "config mismatch?")
+    return node
 
 
 def _materialize(node: Any) -> Any:
@@ -161,9 +219,10 @@ class ModelSerializer:
         for k, v in arrays.items():
             head, _, rest = k.partition("/")
             groups.setdefault(head, {})[rest] = v
-        net.params = _unflatten(groups.get("params", {}))
+        net.params = _unflatten_like(net.params, _nest(groups.get("params", {})))
         if "state" in groups:
-            net.state = _unflatten(groups["state"])
+            net.state = _unflatten_like(net.state, _nest(groups["state"]),
+                                        strict=False)
         if load_updater and training_state.get("has_updater"):
             restored = _unflatten(groups.get("updater", {}))
             # preserve the structural template from init() where the updater
@@ -187,9 +246,10 @@ class ModelSerializer:
         for k, v in arrays.items():
             head, _, rest = k.partition("/")
             groups.setdefault(head, {})[rest] = v
-        net.params = _unflatten(groups.get("params", {}))
+        net.params = _unflatten_like(net.params, _nest(groups.get("params", {})))
         if "state" in groups:
-            net.state = _unflatten(groups["state"])
+            net.state = _unflatten_like(net.state, _nest(groups["state"]),
+                                        strict=False)
         if load_updater and training_state.get("has_updater"):
             net.updater_state = _restore_like(
                 net.updater_state, _unflatten(groups.get("updater", {})))

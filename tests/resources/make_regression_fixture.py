@@ -1,0 +1,56 @@
+"""Generate the saved-model format-regression fixture (run from repo root).
+
+Parity: the reference pins zips produced by OLDER releases and asserts they
+still load and predict identically (RegressionTest050.java /
+RegressionTest060.java + dl4j-test-resources). Here the fixture is a model
+saved by the format's first stable version; `tests/test_serialization.py::
+TestFormatRegression` must load it and reproduce `expected.npz` forever —
+any format change must stay backward-compatible or version-gate.
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util import save_model
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    conf = (NeuralNetConfiguration.builder().seed(1234).updater("adam")
+            .learning_rate(0.01).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    r = np.random.RandomState(7)
+    x = r.rand(8, 8, 8, 1).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.randint(0, 3, 8)]
+    for _ in range(3):
+        net.fit_batch(x, y)
+    save_model(net, os.path.join(here, "regression_v1.zip"),
+               save_updater=True)
+    np.savez(os.path.join(here, "regression_v1_expected.npz"),
+             x=x, y=y, out=np.asarray(net.output(x)),
+             score=np.float64(net.score_for(x, y)))
+    print("fixture written")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Backend parity: the same network must produce the same numbers on the
+compiled TPU backend as on CPU.
+
+Parity: the reference cross-validates its accelerated helper path against
+the plain CPU path (``deeplearning4j-cuda/src/test/.../CuDNNGradientChecks
+.java``, ``TestConvolution.java`` — helper on vs off, assert agreement).
+Here the two "backends" are the default JAX platform (the real TPU chip
+when this harness has one) and the forced-CPU platform the rest of the
+suite runs on.
+
+Mechanics: the whole suite pins ``jax_platforms=cpu`` before JAX init
+(``conftest.py``), so the TPU half runs in a SUBPROCESS with a clean
+environment. Skips loudly when no accelerator is present. Matmul/conv
+precision is pinned to ``highest`` on both sides so the comparison checks
+the compilation path, not bf16 MXU rounding.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+sys.path.insert(0, %(repo)r)
+plat = jax.devices()[0].platform
+if plat == "cpu":
+    print(json.dumps({"platform": "cpu"}))
+    sys.exit(0)
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+conf = MultiLayerConfiguration.from_json(open(sys.argv[1]).read())
+net = MultiLayerNetwork(conf).init()
+d = np.load(sys.argv[2])
+x, y = d["x"], d["y"]
+out = np.asarray(net.output(x), dtype=np.float64)
+score = float(net.score_for(x, y))
+net.fit_batch(x, y)
+score_after = float(net.score_for(x, y))
+np.savez(sys.argv[3], out=out)
+print(json.dumps({"platform": plat, "score": score,
+                  "score_after": score_after}))
+"""
+
+
+def _conf():
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                                   ConvolutionLayer,
+                                                   DenseLayer, OutputLayer,
+                                                   SubsamplingLayer)
+    return (NeuralNetConfiguration.builder().seed(77).updater("sgd")
+            .learning_rate(0.05).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(10, 10, 1)).build())
+
+
+class TestBackendParity:
+    def test_tpu_matches_cpu(self, rng, tmp_path):
+        import jax
+
+        conf = _conf()
+        conf_path = tmp_path / "conf.json"
+        conf_path.write_text(conf.to_json())
+        x = rng.normal(size=(8, 10, 10, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        data_path = tmp_path / "data.npz"
+        np.savez(data_path, x=x, y=y)
+        out_path = tmp_path / "tpu_out.npz"
+
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")}
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD % {"repo": _REPO},
+             str(conf_path), str(data_path), str(out_path)],
+            capture_output=True, text=True, env=env, timeout=420)
+        assert proc.returncode == 0, f"accelerator child failed:\n{proc.stderr}"
+        info = json.loads(proc.stdout.strip().splitlines()[-1])
+        if info["platform"] == "cpu":
+            pytest.skip("no accelerator platform available — backend-parity "
+                        "test needs the TPU harness")
+
+        # CPU side, identical init (deterministic from config seed), f32
+        with jax.default_matmul_precision("highest"):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            net = MultiLayerNetwork(_conf()).init()
+            cpu_out = np.asarray(net.output(x), dtype=np.float64)
+            cpu_score = float(net.score_for(x, y))
+            net.fit_batch(x, y)
+            cpu_score_after = float(net.score_for(x, y))
+
+        tpu_out = np.load(out_path)["out"]
+        np.testing.assert_allclose(tpu_out, cpu_out, rtol=1e-4, atol=1e-5)
+        assert info["score"] == pytest.approx(cpu_score, rel=1e-4)
+        # one SGD step: compiled update path agrees across backends
+        assert info["score_after"] == pytest.approx(cpu_score_after, rel=1e-3)

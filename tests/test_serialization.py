@@ -117,3 +117,32 @@ class TestExtensionDtypes:
         assert np.dtype(probe.dtype) == np.dtype(ml_dtypes.bfloat16)
         assert np.allclose(np.asarray(probe, dtype=np.float32),
                            np.arange(8, dtype=np.float32))
+
+
+class TestFormatRegression:
+    """Checked-in fixture from the format's first stable version must load
+    and predict identically forever (parity: reference
+    ``regressiontest/RegressionTest050.java`` / ``RegressionTest060.java``
+    loading zips saved by older releases). If the serialization format
+    changes, it must stay backward-compatible — regenerating the fixture to
+    make this pass defeats its purpose."""
+
+    def test_v1_fixture_loads_and_predicts(self):
+        import os
+        here = os.path.join(os.path.dirname(__file__), "resources")
+        exp = np.load(os.path.join(here, "regression_v1_expected.npz"))
+        net = load_model(os.path.join(here, "regression_v1.zip"))
+        out = np.asarray(net.output(exp["x"]))
+        np.testing.assert_allclose(out, exp["out"], rtol=1e-5, atol=1e-6)
+        assert float(net.score_for(exp["x"], exp["y"])) == pytest.approx(
+            float(exp["score"]), rel=1e-5)
+
+    def test_v1_fixture_resumes_training(self):
+        import os
+        here = os.path.join(os.path.dirname(__file__), "resources")
+        exp = np.load(os.path.join(here, "regression_v1_expected.npz"))
+        net = load_model(os.path.join(here, "regression_v1.zip"))
+        s0 = float(net.score_for(exp["x"], exp["y"]))
+        for _ in range(3):
+            net.fit_batch(exp["x"], exp["y"])
+        assert float(net.score_for(exp["x"], exp["y"])) < s0
