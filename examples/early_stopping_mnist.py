@@ -1,0 +1,45 @@
+"""Early stopping with score-improvement termination and a model saver.
+
+DL4J analog: `EarlyStoppingMNIST`-style setup — EarlyStoppingConfiguration
+with MaxEpochs + ScoreImprovementEpochs terminations, DataSetLossCalculator
+on a held-out iterator, LocalFileModelSaver, then load the BEST model.
+
+Run: python examples/early_stopping_mnist.py [--smoke]
+"""
+import sys
+import tempfile
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.models import lenet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def main(smoke: bool = False):
+    n = 512 if smoke else 10000
+    train = MnistDataSetIterator(batch_size=64, num_examples=n)
+    val = MnistDataSetIterator(batch_size=256, num_examples=n // 4,
+                               train=False)
+
+    saver = LocalFileModelSaver(tempfile.mkdtemp())
+    es = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(val),
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(2 if smoke else 20),
+            ScoreImprovementEpochTerminationCondition(3)],
+        model_saver=saver,
+        evaluate_every_n_epochs=1)
+
+    net = MultiLayerNetwork(lenet()).init()
+    result = EarlyStoppingTrainer(es, net, train).fit()
+    print(f"terminated: {result.termination_reason} "
+          f"(epoch {result.best_model_epoch}, score {result.best_model_score:.4f})")
+    best = saver.get_best_model()
+    print("best model restored:", best is not None)
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

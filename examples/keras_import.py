@@ -1,0 +1,55 @@
+"""Import a Keras HDF5 model.
+
+DL4J analog: `Model.importSequentialModel` / `importFunctionalApiModel`
+(deeplearning4j-modelimport). This example builds a tiny Keras-format HDF5
+file with h5py (no TensorFlow needed), imports it as a MultiLayerNetwork,
+and runs a forward pass.
+
+Run: python examples/keras_import.py
+"""
+import json
+import os
+import tempfile
+
+import h5py
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+
+def write_sequential_fixture(path):
+    """Dense(4, relu) -> Dense(3, softmax) Keras Sequential archive."""
+    rng = np.random.RandomState(0)
+    config = {
+        "class_name": "Sequential",
+        "config": {"name": "seq", "layers": [
+            {"class_name": "Dense", "config": {
+                "name": "dense_1", "units": 4, "activation": "relu",
+                "batch_input_shape": [None, 5]}},
+            {"class_name": "Dense", "config": {
+                "name": "dense_2", "units": 3, "activation": "softmax"}},
+        ]},
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(config).encode()
+        mw = f.create_group("model_weights")
+        for name, (nin, nout) in [("dense_1", (5, 4)), ("dense_2", (4, 3))]:
+            g = mw.create_group(name)
+            g.create_dataset(f"{name}/kernel:0",
+                             data=rng.randn(nin, nout).astype(np.float32))
+            g.create_dataset(f"{name}/bias:0",
+                             data=np.zeros(nout, dtype=np.float32))
+
+
+def main():
+    path = os.path.join(tempfile.mkdtemp(), "model.h5")
+    write_sequential_fixture(path)
+    net = KerasModelImport.import_sequential_model(path)
+    x = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+    out = np.asarray(net.output(x))
+    print("output shape:", out.shape, "rows sum to 1:",
+          bool(np.allclose(out.sum(axis=1), 1.0, atol=1e-5)))
+
+
+if __name__ == "__main__":
+    main()

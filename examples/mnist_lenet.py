@@ -1,0 +1,34 @@
+"""LeNet on MNIST — the minimum end-to-end slice.
+
+DL4J analog: the classic `LenetMnistExample` (MultiLayerNetwork +
+MnistDataSetIterator). One jitted, donated train step; NHWC activations.
+
+Run: python examples/mnist_lenet.py [--smoke]
+"""
+import sys
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.models import lenet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+
+def main(smoke: bool = False):
+    n_train, n_test, epochs = (512, 256, 1) if smoke else (60000, 10000, 2)
+    net = MultiLayerNetwork(lenet()).init()
+    net.add_listener(ScoreIterationListener(print_iterations=50,
+                                            log_fn=print))
+
+    train = MnistDataSetIterator(batch_size=64, num_examples=n_train)
+    net.fit(train, epochs=epochs)
+
+    test = MnistDataSetIterator(batch_size=256, num_examples=n_test,
+                                train=False)
+    ev = net.evaluate(test)
+    print(ev.stats())
+    print(f"accuracy: {ev.accuracy():.4f}")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

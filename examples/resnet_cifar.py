@@ -1,0 +1,35 @@
+"""Residual network on CIFAR-10 as a ComputationGraph.
+
+DL4J analog: ComputationGraph examples with ElementWiseVertex residual
+adds. The whole DAG traces into ONE XLA program; with
+`gradient_checkpointing` it rematerializes segment interiors when HBM is
+tight.
+
+Run: python examples/resnet_cifar.py [--smoke]
+"""
+import sys
+
+from deeplearning4j_tpu.datasets.fetchers import CifarDataSetIterator
+from deeplearning4j_tpu.models import resnet
+from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+
+def main(smoke: bool = False):
+    blocks, n_ex, epochs = ((1, 1), 256, 1) if smoke else ((2, 2, 2), 50000, 5)
+    conf = resnet(blocks=blocks, height=32, width=32, n_classes=10,
+                  width_base=16 if smoke else 64, dtype="float32",
+                  learning_rate=0.05)
+    net = ComputationGraph(conf).init()
+
+    train = CifarDataSetIterator(batch_size=64, num_examples=n_ex)
+    net.fit(train, epochs=epochs)
+
+    test = CifarDataSetIterator(batch_size=256,
+                                num_examples=max(256, n_ex // 5), train=False)
+    ev = net.evaluate(test)
+    print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

@@ -1,0 +1,46 @@
+"""HTTP model serving + the training dashboard.
+
+DL4J analogs: the Camel serve route (`DL4jServeRouteBuilder`) and the Play
+UI server (`UIServer.getInstance().attach(storage)`).
+
+Run: python examples/serving_and_dashboard.py [--smoke]
+"""
+import json
+import sys
+import urllib.request
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.models import lenet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import InferenceServer
+from deeplearning4j_tpu.storage import InMemoryStatsStorage
+from deeplearning4j_tpu.ui import StatsListener, UIServer
+
+
+def main(smoke: bool = False):
+    n = 256 if smoke else 10000
+    storage = InMemoryStatsStorage()
+    net = MultiLayerNetwork(lenet()).init()
+    net.add_listener(StatsListener(storage, collect_histograms=True))
+    net.fit(MnistDataSetIterator(batch_size=64, num_examples=n), epochs=1)
+
+    ui = UIServer(port=0).attach(storage)   # overview/model/system + histograms
+    print(f"dashboard: http://localhost:{ui.port}/")
+
+    x = next(iter(MnistDataSetIterator(batch_size=4, num_examples=8,
+                                       train=False))).features
+    net.output(x[:1])               # warm the compile before serving
+    srv = InferenceServer(net)
+    req = urllib.request.Request(
+        f"http://localhost:{srv.port}/predict",
+        data=json.dumps({"inputs": x.tolist()}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        preds = json.loads(resp.read())["outputs"]
+    print(f"served {len(preds)} predictions over HTTP")
+    srv.stop()
+    ui.stop()
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

@@ -1,0 +1,47 @@
+"""Word2Vec skip-gram with negative sampling over a sentence source.
+
+DL4J analog: `Word2VecRawTextExample` — builder, tokenizer factory,
+`wordsNearest`, and Google-format serialization round-trip.
+
+Run: python examples/word2vec_text.py [--smoke]
+"""
+import os
+import sys
+import tempfile
+
+from deeplearning4j_tpu.nlp.sentence_iterator import CollectionSentenceIterator
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, WordVectorSerializer
+
+SENTENCES = [
+    "the day was bright and the night was dark",
+    "day follows night and night follows day",
+    "a bright morning is the start of the day",
+    "the dark evening is the start of the night",
+    "cats and dogs are animals",
+    "dogs chase cats and cats chase mice",
+] * 40
+
+
+def main(smoke: bool = False):
+    w2v = (Word2Vec.builder()
+           .layer_size(16 if smoke else 100)
+           .window_size(3).min_word_frequency(2)
+           .negative_sample(5)
+           .epochs(1 if smoke else 5)
+           .seed(42)
+           .iterate(CollectionSentenceIterator(SENTENCES))
+           .tokenizer_factory(DefaultTokenizerFactory())
+           .build())
+    w2v.fit()
+    print("nearest to 'day':", w2v.words_nearest("day", top=5))
+
+    path = os.path.join(tempfile.mkdtemp(), "vectors.txt")
+    WordVectorSerializer.write_word_vectors(w2v, path)
+    restored = WordVectorSerializer.load_txt_vectors(path)
+    print("vocab round-trips:",
+          restored.vocab.num_words() == w2v.vocab.num_words())
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

@@ -1,0 +1,30 @@
+"""Every example in examples/ must run end-to-end in --smoke mode.
+
+Examples are user-facing documentation; a broken example is a broken
+contract. Each runs in a subprocess on the forced-CPU 8-device mesh (same
+environment as the rest of the suite)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(_REPO, "examples"))
+    if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("script", _EXAMPLES)
+def test_example_smoke(script):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script), "--smoke"],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=_REPO)
+    assert proc.returncode == 0, (
+        f"{script} failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
