@@ -563,6 +563,16 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """(parity: the reference's ``toYaml`` Jackson mapper)"""
+        import yaml
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
+
 
 class GraphBuilder:
     """Fluent DAG builder (parity: ``ComputationGraphConfiguration.GraphBuilder``

@@ -154,3 +154,24 @@ class RBM(BasePretrainLayer):
         vbias_term = v @ params["vb"].astype(v.dtype)
         hidden_term = jnp.sum(jax.nn.softplus(wx_b), axis=-1)
         return -jnp.mean(hidden_term + vbias_term)
+
+
+def make_pretrain_step(layer, lr: float, policy=None):
+    """Jitted one-batch pretrain update for a pretrainable layer — CD-k for
+    RBMs, reconstruction-loss SGD for autoencoders. The single definition
+    shared by MultiLayerNetwork.pretrain and ComputationGraph.pretrain."""
+    if hasattr(layer, "contrastive_divergence_grads"):
+        @jax.jit
+        def step(lparams, v, rng):
+            grads = layer.contrastive_divergence_grads(lparams, v, rng)
+            return jax.tree_util.tree_map(
+                lambda p, g: p - lr * g.astype(p.dtype), lparams, grads)
+        return step
+
+    @jax.jit
+    def step(lparams, x, rng):
+        grads = jax.grad(
+            lambda p: layer.pretrain_loss(p, x, rng, policy=policy))(lparams)
+        return jax.tree_util.tree_map(
+            lambda p, g: p - lr * g.astype(p.dtype), lparams, grads)
+    return step

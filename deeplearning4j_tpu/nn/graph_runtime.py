@@ -54,6 +54,7 @@ class ComputationGraph:
         self.epoch_count = 0
         self._score = None
         self._updater = None
+        self._rnn_state: Optional[Dict[str, Dict[str, jax.Array]]] = None
         self._jit_cache: Dict[str, Any] = {}
 
         self._output_layer_names = [
@@ -122,8 +123,14 @@ class ComputationGraph:
     # functional forward over the DAG
     # ------------------------------------------------------------------
 
-    def _states_map(self) -> Dict[str, Dict[str, jax.Array]]:
-        return {n: dict(self.state.get(n, {})) for n in self.topo_order}
+    def _states_map(self, rnn_state=None) -> Dict[str, Dict[str, jax.Array]]:
+        out = {}
+        for n in self.topo_order:
+            st = dict(self.state.get(n, {}))
+            if rnn_state is not None and rnn_state.get(n):
+                st.update(rnn_state[n])
+            out[n] = st
+        return out
 
     def _persist_states(self, new_states: Dict[str, Dict[str, jax.Array]]) -> None:
         for name, keys in self._persistent_keys.items():
@@ -273,6 +280,38 @@ class ComputationGraph:
                if train else None)
         outs = fn(self.params, self._states_map(), inputs, rng)
         return outs[0] if len(outs) == 1 else outs
+
+    def rnn_time_step(self, *inputs):
+        """Streaming inference: feed one (or a few) timesteps, carrying each
+        recurrent vertex's h/c between calls (parity: the reference
+        ComputationGraph's ``rnnTimeStep`` with per-vertex state maps).
+        Inputs: [b, f] (single step, output squeezed back) or [b, t, f]."""
+        inputs = [jnp.asarray(x) for x in _as_list(
+            inputs[0] if len(inputs) == 1 and isinstance(inputs[0], (list, tuple))
+            else list(inputs))]
+        squeeze = inputs[0].ndim == 2
+        if squeeze:
+            inputs = [x[:, None, :] for x in inputs]
+        fn = self._jit_cache.get("rnn_time_step")
+        if fn is None:
+            @jax.jit
+            def fn(params, states, inputs):
+                acts, new_states = self._forward(params, states, inputs,
+                                                 train=False)
+                carry = {name: {k: v for k, v in st.items()
+                                if k in ("h", "c")}
+                         for name, st in new_states.items()}
+                return [acts[n] for n in self.conf.network_outputs], carry
+            self._jit_cache["rnn_time_step"] = fn
+        outs, self._rnn_state = fn(self.params,
+                                   self._states_map(self._rnn_state), inputs)
+        if squeeze:
+            outs = [o[:, 0, :] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reset the streaming rnn carry (parity: ``rnnClearPreviousState``)."""
+        self._rnn_state = None
 
     def feed_forward(self, *inputs, train: bool = False) -> Dict[str, jax.Array]:
         """All vertex activations keyed by name."""
@@ -649,6 +688,59 @@ class ComputationGraph:
     def _as_batches(data, labels=None, mask=None):
         from ..util.batching import iter_batches
         return iter_batches(data, labels, mask)
+
+    # ------------------------------------------------------------------
+    # layerwise pretraining (parity: ComputationGraph.pretrain :509-523)
+    # ------------------------------------------------------------------
+
+    def pretrain(self, data, labels=None, *, epochs: int = 1,
+                 learning_rate: Optional[float] = None) -> None:
+        """Greedy layerwise pretraining of AutoEncoder/RBM layer vertices,
+        in topological order: each pretrainable vertex trains on its frozen
+        upstream activations, then the walk moves deeper."""
+        if self.params is None:
+            self.init()
+        lr = float(learning_rate if learning_rate is not None
+                   else self.training.learning_rate)
+        pre = [n for n in self.topo_order
+               if self._vertex_layer(n) is not None
+               and (hasattr(self._vertex_layer(n), "pretrain_loss")
+                    or hasattr(self._vertex_layer(n),
+                               "contrastive_divergence_grads"))]
+        if not pre:
+            return
+        from .conf.pretrain import make_pretrain_step
+        batches = list(self._as_batches(data, labels, None))
+        for name in pre:
+            step = make_pretrain_step(self._vertex_layer(name), lr,
+                                      self.policy)
+            # upstream is frozen while this vertex trains: its input
+            # activations are constant across epochs — compute once
+            hiddens = [self._vertex_input_activation(
+                name, [jnp.asarray(np.asarray(x)) for x in _as_list(ins)])
+                for ins, _, _ in batches]
+            for e in range(epochs):
+                for bi, hidden in enumerate(hiddens):
+                    rng = _rng.fold_name(_rng.key(self.training.seed),
+                                         f"pre_{name}_{e}_{bi}")
+                    self.params[name] = step(self.params[name], hidden, rng)
+
+    def _vertex_input_activation(self, name: str, inputs: List[jax.Array]):
+        """The (preprocessed) input activation a layer vertex sees, with all
+        upstream vertices frozen in eval mode."""
+        fn = self._jit_cache.get(f"pre_acts_{name}")
+        if fn is None:
+            @jax.jit
+            def fn(params, states, inputs):
+                acts, _ = self._forward(params, states, inputs, train=False)
+                x = acts[self.conf.vertex_inputs[name][0]]
+                v = self.conf.vertices[name]
+                if v.preprocessor is not None:
+                    x = v.preprocessor(x, minibatch_size=x.shape[0])
+                return x
+            self._jit_cache[f"pre_acts_{name}"] = fn
+        return fn(self.params, self._states_map(), inputs)
+
 
     # ------------------------------------------------------------------
     # evaluation bridge

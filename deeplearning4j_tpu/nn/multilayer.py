@@ -273,9 +273,16 @@ class MultiLayerNetwork:
         squeeze = x.ndim == 2
         if squeeze:
             x = x[:, None, :]
-        states = self._states_list(self._rnn_state)
-        out, new_states = self._forward(self.params, states, x, train=False)
-        self._rnn_state = self._extract_rnn_carry(new_states)
+        fn = self._jit_cache.get("rnn_time_step")
+        if fn is None:
+            @jax.jit
+            def fn(params, states, x):
+                out, new_states = self._forward(params, states, x,
+                                                train=False)
+                return out, self._extract_rnn_carry(new_states)
+            self._jit_cache["rnn_time_step"] = fn
+        out, self._rnn_state = fn(self.params,
+                                  self._states_list(self._rnn_state), x)
         return out[:, 0, :] if (squeeze and out.ndim == 3) else out
 
     # ------------------------------------------------------------------
@@ -660,14 +667,18 @@ class MultiLayerNetwork:
                    or hasattr(l, "contrastive_divergence_grads")]
         if not pre_idx:
             return
+        from .conf.pretrain import make_pretrain_step
         batches = list(self._as_batches(data, labels, None))
         for i in pre_idx:
-            step = self._make_pretrain_step(i, lr)
+            step = make_pretrain_step(self.layers[i], lr, self.policy)
+            # earlier layers are frozen while layer i trains: its input
+            # activations are constant across epochs — compute once
+            hiddens = [self._activation_upto(jnp.asarray(x), i)
+                       for x, _, _ in batches]
             for e in range(epochs):
-                for bi, (x, _, _) in enumerate(batches):
+                for bi, hidden in enumerate(hiddens):
                     rng = _rng.fold_name(
                         _rng.key(self.training.seed), f"pre_{i}_{e}_{bi}")
-                    hidden = self._activation_upto(jnp.asarray(x), i)
                     self.params[_layer_key(i)] = step(
                         self.params[_layer_key(i)], hidden, rng)
 
@@ -694,24 +705,6 @@ class MultiLayerNetwork:
             self._jit_cache[fn_key] = fn
         return fn(self.params, self._states_list(), x)
 
-    def _make_pretrain_step(self, layer_idx: int, lr: float):
-        layer = self.layers[layer_idx]
-        if hasattr(layer, "contrastive_divergence_grads"):
-            @jax.jit
-            def step(lparams, v, rng):
-                grads = layer.contrastive_divergence_grads(lparams, v, rng)
-                return jax.tree_util.tree_map(
-                    lambda p, g: p - lr * g.astype(p.dtype), lparams, grads)
-            return step
-
-        @jax.jit
-        def step(lparams, x, rng):
-            grads = jax.grad(
-                lambda p: layer.pretrain_loss(p, x, rng, policy=self.policy)
-            )(lparams)
-            return jax.tree_util.tree_map(
-                lambda p, g: p - lr * g.astype(p.dtype), lparams, grads)
-        return step
 
     # ------------------------------------------------------------------
     # evaluation bridge (full Evaluation class in eval/)

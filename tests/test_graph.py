@@ -371,3 +371,64 @@ class TestOutputVertexWithConsumers:
         assert float(net.score()) < s0
         out1, out2 = net.output(x)
         assert out1.shape == (16, 4) and out2.shape == (16, 3)
+
+
+class TestGraphRnnStreaming:
+    """ComputationGraph streaming rnn inference (parity: the reference
+    ComputationGraph's rnnTimeStep/rnnClearPreviousState)."""
+
+    def _conf(self):
+        from deeplearning4j_tpu.nn.conf.recurrent import GravesLSTM
+        from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+        return (NeuralNetConfiguration.builder().seed(4).learning_rate(0.1)
+                .graph_builder().add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_in=6, n_out=8,
+                                              activation="tanh"), "in")
+                .add_layer("out", RnnOutputLayer(n_in=8, n_out=4,
+                                                 activation="softmax",
+                                                 loss="mcxent"), "lstm")
+                .set_outputs("out").build())
+
+    def test_rnn_time_step_matches_full_forward(self, rng):
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        x = rng.normal(size=(2, 5, 6)).astype(np.float32)
+        net = ComputationGraph(self._conf()).init()
+        full = np.asarray(net.output([x]))
+        net.rnn_clear_previous_state()
+        stepped = np.stack(
+            [np.asarray(net.rnn_time_step(x[:, t, :])) for t in range(5)],
+            axis=1)
+        assert np.allclose(full, stepped, atol=1e-5)
+
+    def test_clear_resets_carry(self, rng):
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        x = rng.normal(size=(2, 6)).astype(np.float32)
+        net = ComputationGraph(self._conf()).init()
+        a = np.asarray(net.rnn_time_step(x))
+        b = np.asarray(net.rnn_time_step(x))   # carried state: different
+        net.rnn_clear_previous_state()
+        c = np.asarray(net.rnn_time_step(x))   # fresh: matches first call
+        assert not np.allclose(a, b)
+        assert np.allclose(a, c, atol=1e-6)
+
+
+class TestGraphYamlSerde:
+    def test_yaml_round_trip(self, rng):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = (_base().graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "d1")
+                .add_vertex("sum", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "sum")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(6))
+                .build())
+        conf2 = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        a = np.asarray(ComputationGraph(conf).init().output([x]))
+        b = np.asarray(ComputationGraph(conf2).init().output([x]))
+        assert np.allclose(a, b)

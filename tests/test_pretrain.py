@@ -120,3 +120,39 @@ class TestRBM:
         grads = rbm.contrastive_divergence_grads(params, v, _rng.key(4))
         assert grads["W"].shape == (8, 4)
         assert np.all(np.isfinite(np.asarray(grads["W"])))
+
+
+class TestGraphPretrain:
+    """ComputationGraph layerwise pretraining (parity:
+    ComputationGraph.pretrain — reference :509-523)."""
+
+    def test_graph_pretrain_reduces_reconstruction_error(self, rng):
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        x = _structured_data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater("sgd").learning_rate(0.5)
+                .graph_builder().add_inputs("in")
+                .add_layer("ae1", AutoEncoder(n_in=12, n_out=6,
+                                              activation="sigmoid",
+                                              corruption_level=0.2,
+                                              loss="mse"), "in")
+                .add_layer("ae2", AutoEncoder(n_in=6, n_out=4,
+                                              activation="sigmoid",
+                                              loss="mse"), "ae1")
+                .add_layer("out", OutputLayer(n_in=4, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "ae2")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        ae1 = net.conf.vertices["ae1"].layer
+        e0 = float(ae1.reconstruction_error(net.params["ae1"],
+                                            jnp.asarray(x)))
+        net.pretrain(([x], np.zeros((64, 3), np.float32)), epochs=60)
+        e1 = float(ae1.reconstruction_error(net.params["ae1"],
+                                            jnp.asarray(x)))
+        assert e1 < e0 * 0.7, (e0, e1)
+        # deeper vertex trained on frozen ae1 activations
+        ae2 = net.conf.vertices["ae2"].layer
+        h = net.feed_forward([x])["ae1"]
+        e2 = float(ae2.reconstruction_error(net.params["ae2"], h))
+        assert np.isfinite(e2)
