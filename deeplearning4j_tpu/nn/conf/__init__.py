@@ -8,6 +8,7 @@ InputType-driven nIn inference and automatic preprocessor insertion).
 from .inputs import InputType
 from .builders import NeuralNetConfiguration, ListBuilder
 from .multi_layer import MultiLayerConfiguration
+from . import attention as _attention  # noqa: F401  (serde registration)
 
 __all__ = [
     "InputType", "NeuralNetConfiguration", "ListBuilder", "MultiLayerConfiguration",

@@ -1,0 +1,111 @@
+"""Multi-head self-attention as a config-DSL layer.
+
+The reference has NO attention layer (LSTM era — SURVEY §2.9); this is the
+long-context north-star extension surfaced in the same builder DSL as every
+other layer, so sequence models can mix attention with the reference layer
+set. Works on recurrent activations [b, t, f]; honours sequence masks the
+same way the recurrent layers do (masked keys are not attended, masked
+steps output 0).
+
+The single-device path uses the fused ``ops.attention.dot_product_attention``;
+under a sequence-sharded mesh the same math runs as ring attention
+(``parallel.sequence.SequenceParallelTrainer``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ... import dtypes as _dtypes
+from .inputs import InputType
+from .layers import Layer, register_layer
+from ..weights import init_weights
+
+
+@register_layer("self_attention")
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Causal/bidirectional multi-head self-attention with output projection.
+
+    Params: fused qkv projection ``Wqkv`` [n_in, 3·n_in], output projection
+    ``Wo`` [n_in, n_out], bias ``b`` [n_out]. ``n_in`` must divide by
+    ``n_heads``.
+    """
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None       # defaults to n_in
+    n_heads: int = 4
+    causal: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out or self.n_in,
+                                   input_type.timesteps)
+
+    def set_n_in(self, input_type: InputType, override: bool = False) -> None:
+        if self.n_in is None or override:
+            self.n_in = input_type.flat_size()
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.n_in % self.n_heads:
+            raise ValueError(f"n_in={self.n_in} not divisible by "
+                             f"n_heads={self.n_heads}")
+
+    def preprocessor_for(self, input_type: InputType):
+        # same adapters the recurrent layers insert (BaseRecurrentLayer)
+        from .preprocessors import (CnnToRnnPreProcessor,
+                                    FeedForwardToRnnPreProcessor)
+        if input_type.kind == "feedforward":
+            return FeedForwardToRnnPreProcessor()
+        if input_type.kind == "convolutional":
+            return CnnToRnnPreProcessor(height=input_type.height,
+                                        width=input_type.width,
+                                        channels=input_type.channels)
+        return None
+
+    def has_params(self) -> bool:
+        return True
+
+    def param_shapes(self, policy=None) -> Dict[str, Tuple[int, ...]]:
+        return {"Wqkv": (self.n_in, 3 * self.n_in),
+                "Wo": (self.n_in, self.n_out), "b": (self.n_out,)}
+
+    def regularized_params(self):
+        return ("Wqkv", "Wo")
+
+    def init_params(self, key, policy=None):
+        policy = policy or _dtypes.default_policy()
+        dt = policy.param_dtype
+        k1, k2 = jax.random.split(key)
+        wqkv = init_weights(k1, (self.n_in, 3 * self.n_in),
+                            self.weight_init or "XAVIER",
+                            fan_in=self.n_in, fan_out=self.n_in,
+                            distribution=self.dist, dtype=dt)
+        wo = init_weights(k2, (self.n_in, self.n_out),
+                          self.weight_init or "XAVIER",
+                          fan_in=self.n_in, fan_out=self.n_out,
+                          distribution=self.dist, dtype=dt)
+        return {"Wqkv": wqkv, "Wo": wo,
+                "b": jnp.full((self.n_out,), float(self.bias_init or 0.0),
+                              dt)}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None, policy=None):
+        from ...ops.attention import dot_product_attention
+        policy = policy or _dtypes.default_policy()
+        x = self._dropout_in(x, train, rng)
+        xc, wqkv = policy.cast_to_compute(x, params["Wqkv"])
+        b, t, f = xc.shape
+        h = self.n_heads
+        qkv = (xc @ wqkv).reshape(b, t, 3, h, f // h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = dot_product_attention(q, k, v, causal=self.causal, mask=mask)
+        wo = params["Wo"].astype(att.dtype)
+        out = att.reshape(b, t, f) @ wo + params["b"].astype(att.dtype)
+        out = self._act(self.activation or "identity")(out)
+        if mask is not None:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out, state

@@ -33,7 +33,13 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
         logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :] > 0, logits, -jnp.inf)
-    weights = jax.nn.softmax(logits, axis=-1)
+    # manual stable softmax so a query with NO attendable keys (all -inf —
+    # e.g. leading padded step under a causal mask) outputs 0, not NaN;
+    # same guard the ring path's _block_attend applies
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(jnp.isneginf(logits), 0.0, jnp.exp(logits - m_safe))
+    weights = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 

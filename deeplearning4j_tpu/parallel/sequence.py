@@ -1,0 +1,127 @@
+"""Sequence (context) parallelism: train attention models with the TIME
+axis sharded over a ``seq`` mesh axis.
+
+No reference analog (SURVEY §2.9) — the long-context north-star. Design:
+activations are sharded [b, t/seq, f]; every per-timestep op (projections,
+FFN, loss) partitions trivially under GSPMD, and the one op that mixes
+timesteps — attention — runs as ring attention (``ops.attention``): K/V
+shards rotate over the mesh axis via ``ppermute`` while each device
+accumulates its local queries online. Sequence length scales with chips;
+the [t, t] score matrix never materializes.
+
+Training goes through the ring: the jitted step differentiates through the
+shard_map + scan, so the backward pass rides the same ring collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import rng as _rng
+from ..ops.attention import dot_product_attention, make_ring_attention
+
+Pytree = Any
+
+
+def init_block_params(key, d_model: int, d_ff: int, n_heads: int,
+                      vocab: int, dtype=jnp.float32) -> Pytree:
+    """Causal transformer block LM: in-proj → attention (+res) → FFN (+res)
+    → vocab head."""
+    del n_heads  # head count is a forward-time reshape, not a param shape
+    ks = jax.random.split(key, 5)
+    # scales as typed jnp scalars: a bare numpy float64 would upcast the
+    # whole param tree under jax_enable_x64
+    s_in = jnp.asarray(1.0 / np.sqrt(vocab), dtype)
+    s_d = jnp.asarray(1.0 / np.sqrt(d_model), dtype)
+    s_f = jnp.asarray(1.0 / np.sqrt(d_ff), dtype)
+    return {
+        "Win": jax.random.normal(ks[0], (vocab, d_model), dtype) * s_in,
+        "Wqkv": jax.random.normal(ks[1], (d_model, 3 * d_model), dtype) * s_d,
+        "Wo": jax.random.normal(ks[2], (d_model, d_model), dtype) * s_d,
+        "W1": jax.random.normal(ks[3], (d_model, d_ff), dtype) * s_d,
+        "b1": jnp.zeros((d_ff,), dtype),
+        "W2": jax.random.normal(ks[4], (d_ff, d_model), dtype) * s_f,
+        "b2": jnp.zeros((d_model,), dtype),
+        "Whead": jnp.zeros((d_model, vocab), dtype),
+    }
+
+
+def block_apply(params: Pytree, x: jax.Array, *, n_heads: int,
+                attention_fn) -> jax.Array:
+    """[b, t, vocab] one-hot → [b, t, vocab] logits. ``attention_fn`` is
+    either dense attention or the ring (same [b,t,h,d] contract)."""
+    h = x @ params["Win"]                                   # [b, t, d]
+    b, t, d = h.shape
+    qkv = (h @ params["Wqkv"]).reshape(b, t, 3, n_heads, d // n_heads)
+    att = attention_fn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    h = h + att.reshape(b, t, d) @ params["Wo"]
+    ff = jax.nn.relu(h @ params["W1"] + params["b1"]) @ params["W2"]
+    h = h + ff + params["b2"]
+    return h @ params["Whead"]                              # [b, t, vocab]
+
+
+def lm_loss(params: Pytree, x, y, *, n_heads: int, attention_fn):
+    logits = block_apply(params, x, n_heads=n_heads,
+                         attention_fn=attention_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(y * logp, axis=-1))
+
+
+class SequenceParallelTrainer:
+    """Train the causal block LM with the time axis sharded over ``axis``.
+
+    ``fit_batch(x, y)`` takes GLOBAL [b, t, vocab] arrays (t divisible by
+    the mesh axis size); the jitted donated step shards them over time and
+    differentiates through the ring.
+    """
+
+    def __init__(self, d_model: int, d_ff: int, n_heads: int, vocab: int,
+                 mesh: Mesh, *, axis: str = "seq",
+                 learning_rate: float = 0.1, seed: int = 0):
+        self.mesh = mesh
+        self.axis = axis
+        self.n_heads = int(n_heads)
+        self.lr = float(learning_rate)
+        replicated = NamedSharding(mesh, P())
+        self.params = jax.device_put(
+            init_block_params(_rng.key(seed), d_model, d_ff, n_heads,
+                              vocab), replicated)
+        self._x_sharding = NamedSharding(mesh, P(None, axis, None))
+
+        ring = make_ring_attention(mesh, axis, causal=True)
+        n_heads_ = self.n_heads
+        lr = self.lr
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(params, x, y):
+            loss, grads = jax.value_and_grad(lm_loss)(
+                params, x, y, n_heads=n_heads_, attention_fn=ring)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads)
+            return params, loss
+
+        self._step = step
+        self._forward = jax.jit(functools.partial(
+            block_apply, n_heads=n_heads_, attention_fn=ring))
+
+    def _stage(self, a):
+        return jax.device_put(jnp.asarray(a), self._x_sharding)
+
+    def forward(self, x):
+        return self._forward(self.params, self._stage(x))
+
+    def fit_batch(self, x, y) -> jax.Array:
+        self.params, loss = self._step(self.params, self._stage(x),
+                                       self._stage(y))
+        return loss
+
+
+def dense_attention_fn(q, k, v):
+    """Single-device reference: same contract as the ring."""
+    return dot_product_attention(q, k, v, causal=True)

@@ -112,3 +112,150 @@ class TestRingAttention:
         # masked keys contribute nothing: recompute with truncated k/v
         out_trunc = dot_product_attention(q, q[:, :6], q[:, :6])
         assert np.allclose(np.asarray(out), np.asarray(out_trunc), atol=1e-5)
+
+
+class TestSelfAttentionLayer:
+    """Attention in the config DSL (long-context north star, user surface)."""
+
+    def _conf(self, causal=True):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+        return (NeuralNetConfiguration.builder().seed(3).updater("adam")
+                .learning_rate(1e-2).list()
+                .layer(SelfAttentionLayer(n_heads=2, causal=causal))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(8)).build())
+
+    def test_trains_and_serde(self, rng):
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = self._conf()
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        x = rng.normal(size=(4, 6, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 6))]
+        net = MultiLayerNetwork(conf2).init()
+        losses = [float(net.fit_batch(x, y)) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.8
+
+    def test_causal_mask_is_causal(self, rng):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        import jax
+        layer = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=True)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = rng.normal(size=(2, 6, 8)).astype(np.float32)
+        full, _ = layer.apply(params, jnp.asarray(x))
+        # future perturbation must not change past outputs
+        x2 = np.array(x)
+        x2[:, -1, :] += 10.0
+        pert, _ = layer.apply(params, jnp.asarray(x2))
+        assert np.allclose(np.asarray(full)[:, :-1],
+                           np.asarray(pert)[:, :-1], atol=1e-5)
+
+    def test_sequence_mask_zeroes_and_blocks(self, rng):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        import jax
+        layer = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=False)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = rng.normal(size=(2, 5, 8)).astype(np.float32)
+        mask = np.ones((2, 5), np.float32)
+        mask[:, 3:] = 0.0
+        out, _ = layer.apply(params, jnp.asarray(x), mask=jnp.asarray(mask))
+        out = np.asarray(out)
+        assert np.allclose(out[:, 3:], 0.0)          # masked steps output 0
+        # masked keys don't influence valid steps
+        x2 = np.array(x)
+        x2[:, 3:, :] += 5.0
+        out2, _ = layer.apply(params, jnp.asarray(x2), mask=jnp.asarray(mask))
+        assert np.allclose(out[:, :3], np.asarray(out2)[:, :3], atol=1e-5)
+
+    def test_gradient_check(self, rng):
+        from deeplearning4j_tpu.gradientcheck import check_gradients
+        conf = self._conf()
+        x = rng.normal(size=(2, 4, 8))
+        y = np.eye(3)[rng.integers(0, 3, (2, 4))]
+        r = check_gradients(conf, x, y)
+        assert r.passed, r.failures[:3]
+
+
+class TestSequenceParallelTraining:
+    """Training THROUGH the ring: backward rides the same ppermute ring."""
+
+    def test_loss_and_grads_match_dense(self, rng):
+        import jax
+        from deeplearning4j_tpu.parallel import create_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            SequenceParallelTrainer, dense_attention_fn, lm_loss)
+        mesh = create_mesh({"seq": 4})
+        tr = SequenceParallelTrainer(d_model=8, d_ff=16, n_heads=2,
+                                     vocab=11, mesh=mesh, seed=5)
+        t = 16
+        ids = rng.integers(0, 11, (2, t + 1))
+        eye = np.eye(11, dtype=np.float32)
+        x, y = eye[ids[:, :-1]], eye[ids[:, 1:]]
+        params = jax.device_get(tr.params)
+        dense_loss, dense_grads = jax.value_and_grad(lm_loss)(
+            params, jnp.asarray(x), jnp.asarray(y), n_heads=2,
+            attention_fn=dense_attention_fn)
+        ring_loss = float(tr.fit_batch(x, y))
+        assert ring_loss == pytest.approx(float(dense_loss), rel=1e-5)
+        # one SGD step applied: params moved exactly like dense would
+        stepped = jax.tree_util.tree_map(
+            lambda p, g: p - tr.lr * g, params, dense_grads)
+        for a, b in zip(jax.tree_util.tree_leaves(stepped),
+                        jax.tree_util.tree_leaves(jax.device_get(tr.params))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-6)
+
+    def test_training_converges(self, rng):
+        from deeplearning4j_tpu.parallel import create_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            SequenceParallelTrainer)
+        mesh = create_mesh({"seq": 8})
+        tr = SequenceParallelTrainer(d_model=8, d_ff=16, n_heads=2,
+                                     vocab=7, mesh=mesh, seed=1,
+                                     learning_rate=0.5)
+        # deterministic cyclic sequence — learnable by a causal LM
+        ids = np.array([[(i + j) % 7 for i in range(33)]
+                        for j in range(4)])
+        eye = np.eye(7, dtype=np.float32)
+        x, y = eye[ids[:, :-1]], eye[ids[:, 1:]]
+        losses = [float(tr.fit_batch(x, y)) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    def test_no_attendable_keys_outputs_zero_not_nan(self, rng):
+        # leading padded step + causal mask: query 0 has no keys (code
+        # review r4 — this NaN'd before the stable-softmax guard)
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        import jax
+        layer = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=True)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = rng.normal(size=(2, 5, 8)).astype(np.float32)
+        mask = np.ones((2, 5), np.float32)
+        mask[:, 0] = 0.0
+        out, _ = layer.apply(params, jnp.asarray(x), mask=jnp.asarray(mask))
+        assert np.all(np.isfinite(np.asarray(out)))
+        assert np.allclose(np.asarray(out)[:, 0], 0.0)
+
+    def test_feedforward_predecessor_autoinserts_preprocessor(self, rng):
+        # Dense -> attention composes via FeedForwardToRnnPreProcessor
+        # (code review r4 — used to crash unpacking [b*t, f] as 3-D)
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, RnnOutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(1e-2)
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(SelfAttentionLayer(n_heads=2))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(5)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(2, 4, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 4))]
+        assert np.isfinite(float(net.fit_batch(x, y)))
