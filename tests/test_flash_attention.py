@@ -1,0 +1,118 @@
+"""Pallas flash attention: forward/backward parity vs the XLA path
+(interpret mode on the CPU test backend; the kernel compiles natively on
+TPU — measured in PERF.md's "Pallas flash attention" section)."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops.attention import dot_product_attention
+from deeplearning4j_tpu.ops import flash_attention as fa
+from deeplearning4j_tpu.ops.flash_attention import (flash_attention,
+                                                    flash_available)
+
+
+def _qkv(rng, b=2, t=256, h=2, d=64):
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_dense(self, rng, causal):
+        q, k, v = _qkv(rng)
+        ref = np.asarray(dot_product_attention(q, k, v, causal=causal))
+        out = np.asarray(flash_attention(q, k, v, causal, None, 128, True))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_blockwise_gradients_match_dense(self, rng, causal):
+        # t=256 with block 128: gradients cross tile boundaries, so the
+        # blockwise backward's accumulation over i/j blocks is exercised
+        q, k, v = _qkv(rng, t=256)
+        loss_f = lambda f: lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+        g_ref = jax.grad(loss_f(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_f(lambda q, k, v: flash_attention(
+            q, k, v, causal, None, 128, True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_lse_is_correct(self, rng):
+        q, k, v = _qkv(rng, t=128)
+        b, t, h, d = q.shape
+        to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        _, lse = fa._flash_fwd_btd(to_btd(q), to_btd(k), to_btd(v),
+                                   scale=d ** -0.5, causal=True,
+                                   block_q=128, interpret=True)
+        logits = jnp.einsum("btd,bsd->bts", to_btd(q), to_btd(k)) * d ** -0.5
+        cm = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(cm[None], logits, fa.NEG_INF)
+        ref = jax.scipy.special.logsumexp(logits, axis=-1)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_routing_flag(self, rng, monkeypatch):
+        q, _, _ = _qkv(rng)
+        monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "0")
+        assert not flash_available(q.shape, None)
+        monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "1")
+        assert flash_available(q.shape, None)
+        assert not flash_available(q.shape, np.ones((2, 256)))  # masked
+        assert not flash_available((2, 250, 2, 64), None)       # t % block
+        # auto: long sequences only, and only on a real TPU backend
+        monkeypatch.delenv("DL4JTPU_FLASH_ATTENTION")
+        assert not flash_available((2, 256, 2, 64), None)
+        assert not flash_available((2, 4096, 2, 64), None)      # cpu tests
+
+    def test_streamed_variant_matches_dense(self, rng):
+        # the long-sequence streamed kernel, called directly (its VMEM
+        # threshold is impractical to cross in interpret mode)
+        q, k, v = _qkv(rng, t=256)
+        qt = q.transpose(0, 2, 1, 3).reshape(-1, 256, 64)
+        kt = k.transpose(0, 2, 1, 3).reshape(-1, 256, 64)
+        vt = v.transpose(0, 2, 1, 3).reshape(-1, 256, 64)
+        kernel = functools.partial(fa._fwd_kernel_stream, scale=0.125,
+                                   causal=True, block_q=128, block_k=128,
+                                   nk=2)
+        out, lse = pl.pallas_call(
+            kernel, grid=(qt.shape[0], 2, 2),
+            in_specs=[
+                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 128, 1), lambda b, i, j: (b, i, 0)),
+            ),
+            out_shape=(jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+                       jax.ShapeDtypeStruct(qt.shape[:2] + (1,),
+                                            jnp.float32)),
+            scratch_shapes=[pltpu.VMEM((128, 1), jnp.float32),
+                            pltpu.VMEM((128, 64), jnp.float32),
+                            pltpu.VMEM((128, 1), jnp.float32)],
+            interpret=True)(qt, kt, vt)
+        out = np.asarray(out).reshape(2, 2, 256, 64).transpose(0, 2, 1, 3)
+        ref = np.asarray(dot_product_attention(q, k, v, causal=True,
+                                               scale=0.125))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_wide_block_backward_matches_dense(self, rng):
+        # t divisible by 512 engages the 512-wide backward tiles
+        q, k, v = _qkv(rng, b=1, t=1024, h=1, d=64)
+        loss_f = lambda f: lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+        g_ref = jax.grad(loss_f(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_f(lambda q, k, v: flash_attention(
+            q, k, v, True, None, 128, True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
