@@ -339,6 +339,46 @@ def bench_word2vec() -> dict:
             "step_ms": round(1000 * dt / (rounds * k), 3)}
 
 
+def bench_flash_attention() -> dict:
+    """Long-context attention (beyond the BASELINE set): the Pallas flash
+    kernel vs the XLA fused path at bf16 t=8192 — the long-sequence hot op
+    behind SelfAttentionLayer / sequence models. See PERF.md."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.attention import dot_product_attention
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+    b, t, h, d = 4, 8192, 8, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+    f_xla = jax.jit(lambda q, k, v: jnp.sum(
+        dot_product_attention(q, k, v, causal=True).astype(jnp.float32)))
+    f_flash = jax.jit(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, True).astype(jnp.float32)))
+
+    def _t(f, iters=15):
+        float(f(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s = f(q, k, v)
+        float(s)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    os.environ["DL4JTPU_FLASH_ATTENTION"] = "0"   # force f_xla's route
+    try:
+        ms_xla = _t(f_xla)
+    finally:
+        os.environ.pop("DL4JTPU_FLASH_ATTENTION", None)
+    ms_flash = _t(f_flash)
+    flops = 4.0 * b * h * t * t * d / 2  # causal
+    return {"xla_ms": round(ms_xla, 2), "flash_ms": round(ms_flash, 2),
+            "speedup": round(ms_xla / ms_flash, 2),
+            "flash_tflops": round(flops / ms_flash / 1e9, 1),
+            "seq_len": t, "dtype": "bfloat16"}
+
+
 def main() -> None:
     import jax
     device = str(jax.devices()[0].device_kind)
@@ -352,6 +392,7 @@ def main() -> None:
             _run_config(out, "resnet50_pipeline", bench_resnet50_pipeline)
     _run_config(out, "lstm", bench_lstm)
     _run_config(out, "word2vec", bench_word2vec)
+    _run_config(out, "flash_attention", bench_flash_attention)
 
     if resnet_res is not None:
         out.update({

@@ -12,6 +12,7 @@ from .lenet import lenet
 from .resnet import resnet, resnet50
 from .char_rnn import char_rnn_lstm
 from .classic import alexnet, deep_autoencoder, vgg16
+from .transformer import transformer_lm
 
 __all__ = ["lenet", "resnet", "resnet50", "char_rnn_lstm",
-           "alexnet", "vgg16", "deep_autoencoder"]
+           "alexnet", "vgg16", "deep_autoencoder", "transformer_lm"]

@@ -1,0 +1,76 @@
+"""Decoder-only transformer LM as a ComputationGraphConfiguration.
+
+No reference analog (the reference is LSTM-era); this is the long-context
+model family built from the framework's own DSL pieces: pre-norm blocks of
+``SelfAttentionLayer`` + time-distributed FFN with ``ElementWiseVertex``
+residual adds, trained like any other ComputationGraph (one jitted step,
+works with remat, and the attention op auto-routes to the Pallas flash
+kernel at long sequence lengths — see ops/flash_attention.py).
+
+Layout bookkeeping: dense layers auto-flatten recurrent activations to
+[b·t, f] (``RnnToFeedForwardPreProcessor``); a ``PreprocessorVertex``
+rebuilds [b, t, f] before each residual add so both arms agree.
+
+Inputs are one-hot [b, t, vocab]; ``RnnOutputLayer`` gives per-step
+softmax + mcxent, so training/eval/serde all ride the standard paths.
+"""
+
+from __future__ import annotations
+
+from ..nn.conf.attention import SelfAttentionLayer
+from ..nn.conf.builders import NeuralNetConfiguration
+from ..nn.conf.graph import ElementWiseVertex, PreprocessorVertex
+from ..nn.conf.inputs import InputType
+from ..nn.conf.layers import DenseLayer, LayerNormalization, RnnOutputLayer
+from ..nn.conf.preprocessors import FeedForwardToRnnPreProcessor
+
+
+def transformer_lm(vocab_size: int, *, n_layers: int = 4,
+                   d_model: int = 256, n_heads: int = 4, d_ff: int = 1024,
+                   updater: str = "adam", learning_rate: float = 3e-4,
+                   seed: int = 42, dtype: str = "float32"):
+    """Causal LM: in-proj → n_layers × [ln → attention (+res) → ln → ffn
+    (+res)] → final ln → vocab head."""
+    if d_model % n_heads:
+        raise ValueError(f"d_model={d_model} not divisible by "
+                         f"n_heads={n_heads}")
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed).updater(updater).learning_rate(learning_rate)
+          .dtype(dtype)
+          .graph_builder()
+          .add_inputs("in"))
+    gb.add_layer("embed", DenseLayer(n_in=vocab_size, n_out=d_model,
+                                     activation="identity"), "in")
+    gb.add_vertex("embed_rnn",
+                  PreprocessorVertex(FeedForwardToRnnPreProcessor()),
+                  "embed")
+    prev = "embed_rnn"
+    for i in range(n_layers):
+        b = f"blk{i}"
+        gb.add_layer(f"{b}_ln1", LayerNormalization(), prev)
+        gb.add_layer(f"{b}_attn",
+                     SelfAttentionLayer(n_in=d_model, n_out=d_model,
+                                        n_heads=n_heads, causal=True),
+                     f"{b}_ln1")
+        gb.add_vertex(f"{b}_res1", ElementWiseVertex(op="add"),
+                      prev, f"{b}_attn")
+        gb.add_layer(f"{b}_ln2", LayerNormalization(), f"{b}_res1")
+        gb.add_layer(f"{b}_ff1", DenseLayer(n_in=d_model, n_out=d_ff,
+                                            activation="relu"),
+                     f"{b}_ln2")
+        gb.add_layer(f"{b}_ff2", DenseLayer(n_in=d_ff, n_out=d_model,
+                                            activation="identity"),
+                     f"{b}_ff1")
+        gb.add_vertex(f"{b}_ff_rnn",
+                      PreprocessorVertex(FeedForwardToRnnPreProcessor()),
+                      f"{b}_ff2")
+        gb.add_vertex(f"{b}_res2", ElementWiseVertex(op="add"),
+                      f"{b}_res1", f"{b}_ff_rnn")
+        prev = f"{b}_res2"
+    gb.add_layer("final_ln", LayerNormalization(), prev)
+    gb.add_layer("out", RnnOutputLayer(n_in=d_model, n_out=vocab_size,
+                                       activation="softmax", loss="mcxent"),
+                 "final_ln")
+    gb.set_outputs("out")
+    gb.set_input_types(InputType.recurrent(vocab_size))
+    return gb.build()

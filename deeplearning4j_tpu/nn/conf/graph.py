@@ -115,7 +115,7 @@ class GraphVertex:
 
     # ---- forward: (params, [x...], state, train, rng, [mask...]) ----
     def apply(self, params, xs: List[jax.Array], *, state=None, train=False,
-              rng=None, masks=None, policy=None):
+              rng=None, masks=None, policy=None, minibatch=None):
         raise NotImplementedError
 
     def output_mask(self, masks: Optional[List[Optional[jax.Array]]],
@@ -129,6 +129,13 @@ class GraphVertex:
             if m is not None:
                 return m
         return None
+
+    def output_minibatch(self, in_mbs: List[int]) -> int:
+        """The EXAMPLE count of this vertex's output. Time-flattened
+        activations make shape[0] = b·t, so the runtime tracks the true
+        example count along the DAG; batch-axis vertices (Stack/Unstack)
+        override."""
+        return in_mbs[0]
 
 
 @register_vertex("layer")
@@ -165,11 +172,13 @@ class LayerVertex(GraphVertex):
         self.layer.set_n_in(it, override)
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         x = xs[0]
         mask = masks[0] if masks else None
         if self.preprocessor is not None:
-            mb = x.shape[0]
+            # the NETWORK minibatch, not x.shape[0]: time-flattened inputs
+            # arrive as [b*t, f] and FeedForwardToRnn must rebuild [b, t, f]
+            mb = minibatch if minibatch is not None else x.shape[0]
             x = call_preprocessor(self.preprocessor, x, minibatch_size=mb,
                                   rng=rng)
             mask = self.preprocessor.transform_mask(mask, minibatch_size=mb)
@@ -196,7 +205,7 @@ class MergeVertex(GraphVertex):
         return InputType.feed_forward(sum(t.flat_size() for t in input_types))
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         return jnp.concatenate(xs, axis=-1), state
 
 
@@ -213,7 +222,7 @@ class ElementWiseVertex(GraphVertex):
         return input_types[0]
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         op = self.op.lower()
         if op == "add":
             out = xs[0]
@@ -257,7 +266,7 @@ class SubsetVertex(GraphVertex):
         return InputType.feed_forward(n)
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         return xs[0][..., self.from_idx:self.to_idx + 1], state
 
 
@@ -271,7 +280,7 @@ class StackVertex(GraphVertex):
         return input_types[0]
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         return jnp.concatenate(xs, axis=0), state
 
     def output_mask(self, masks, minibatch=None):
@@ -280,6 +289,9 @@ class StackVertex(GraphVertex):
         if any(m is None for m in masks):
             raise ValueError("StackVertex: either all or no inputs may be masked")
         return jnp.concatenate(masks, axis=0)
+
+    def output_minibatch(self, in_mbs):
+        return sum(in_mbs)
 
 
 @register_vertex("unstack")
@@ -295,7 +307,7 @@ class UnstackVertex(GraphVertex):
         return input_types[0]
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         x = xs[0]
         step = x.shape[0] // self.stack_size
         return x[self.from_idx * step:(self.from_idx + 1) * step], state
@@ -306,6 +318,9 @@ class UnstackVertex(GraphVertex):
             return None
         step = m.shape[0] // self.stack_size
         return m[self.from_idx * step:(self.from_idx + 1) * step]
+
+    def output_minibatch(self, in_mbs):
+        return in_mbs[0] // self.stack_size
 
 
 @register_vertex("scale")
@@ -319,7 +334,7 @@ class ScaleVertex(GraphVertex):
         return input_types[0]
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         return xs[0] * self.scale, state
 
 
@@ -334,7 +349,7 @@ class ShiftVertex(GraphVertex):
         return input_types[0]
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         return xs[0] + self.shift, state
 
 
@@ -350,7 +365,7 @@ class L2Vertex(GraphVertex):
         return InputType.feed_forward(1)
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         a = xs[0].reshape(xs[0].shape[0], -1)
         b = xs[1].reshape(xs[1].shape[0], -1)
         d2 = jnp.sum(jnp.square(a - b), axis=1, keepdims=True)
@@ -369,7 +384,7 @@ class L2NormalizeVertex(GraphVertex):
         return input_types[0]
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         x = xs[0]
         axes = tuple(range(1, x.ndim))
         norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True)
@@ -389,9 +404,11 @@ class PreprocessorVertex(GraphVertex):
         return self.preprocessor.output_type(input_types[0])
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         x = xs[0]
-        return self.preprocessor(x, minibatch_size=x.shape[0]), state
+        mb = minibatch if minibatch is not None else x.shape[0]
+        return call_preprocessor(self.preprocessor, x, minibatch_size=mb,
+                                 rng=rng), state
 
     def output_mask(self, masks, minibatch: Optional[int] = None):
         m = masks[0] if masks else None
@@ -410,7 +427,7 @@ class LastTimeStepVertex(GraphVertex):
         return InputType.feed_forward(input_types[0].size)
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         x = xs[0]
         mask = masks[0] if masks else None
         if mask is None:
@@ -439,7 +456,7 @@ class DuplicateToTimeSeriesVertex(GraphVertex):
                                    ref.timesteps if ref else None)
 
     def apply(self, params, xs, *, state=None, train=False, rng=None,
-              masks=None, policy=None):
+              masks=None, policy=None, minibatch=None):
         x, ref = xs[0], xs[1]
         t = ref.shape[1]
         return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[1])), state

@@ -608,6 +608,61 @@ class BatchNormalization(Layer):
             x, g, b, state["mean"], state["var"], self.eps), state
 
 
+@register_layer("layer_norm")
+@dataclasses.dataclass
+class LayerNormalization(Layer):
+    """Layer normalization over the feature (last) axis.
+
+    No reference analog (the reference predates transformers); included as
+    the normalization the attention stack needs (``SelfAttentionLayer`` /
+    ``models/transformer.py``). Stateless — per-example statistics, no
+    running averages — and shape-preserving on [b, f], [b, t, f], NHWC.
+    """
+
+    n_out: Optional[int] = None          # feature count (inferred)
+    eps: float = 1e-5
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type: InputType, override: bool = False) -> None:
+        if self.n_out is None or override:
+            if input_type.kind == "convolutional":
+                self.n_out = input_type.channels
+            else:
+                self.n_out = (input_type.size
+                              if input_type.kind == "recurrent"
+                              else input_type.flat_size())
+
+    def has_params(self) -> bool:
+        return True
+
+    def regularized_params(self) -> Tuple[str, ...]:
+        return ()
+
+    def param_shapes(self, policy=None):
+        return {"gamma": (self.n_out,), "beta": (self.n_out,)}
+
+    def init_params(self, key, policy=None):
+        policy = policy or _dtypes.default_policy()
+        dt = policy.param_dtype
+        return {"gamma": jnp.ones((self.n_out,), dt),
+                "beta": jnp.zeros((self.n_out,), dt)}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None, policy=None):
+        # normalize in at least f32 (bf16 variance over wide features
+        # underflows; f64 stays f64 for gradient checking), return in the
+        # activation dtype
+        cdt = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(cdt)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["gamma"].astype(cdt) + params["beta"].astype(cdt)
+        return y.astype(x.dtype), state
+
+
 @register_layer("lrn")
 @dataclasses.dataclass
 class LocalResponseNormalization(Layer):

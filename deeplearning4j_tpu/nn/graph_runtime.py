@@ -138,9 +138,24 @@ class ComputationGraph:
                 self.state[name] = {
                     k: new_states[name][k] for k in keys if k in new_states[name]}
 
+    def _minibatch_map(self, batch: int) -> Dict[str, int]:
+        """True EXAMPLE count at every vertex (batch-axis vertices like
+        Stack/Unstack change it; time-flattening does not). Host-side ints,
+        cached per input batch size."""
+        cache = self._jit_cache.setdefault("_mb_maps", {})
+        mbs = cache.get(batch)
+        if mbs is None:
+            mbs = {n: batch for n in self.conf.network_inputs}
+            for name in self.topo_order:
+                mbs[name] = self.conf.vertices[name].output_minibatch(
+                    [mbs[i] for i in self.conf.vertex_inputs[name]])
+            cache[batch] = mbs
+        return mbs
+
     def _forward(self, params, states, inputs: List[jax.Array], *,
                  train: bool, rng=None, masks=None):
         """Walk the topo order; returns ({vertex: activation}, new_states)."""
+        mbs = self._minibatch_map(inputs[0].shape[0])
         acts: Dict[str, jax.Array] = dict(zip(self.conf.network_inputs, inputs))
         mask_map: Dict[str, Optional[jax.Array]] = dict(
             zip(self.conf.network_inputs,
@@ -152,7 +167,8 @@ class ComputationGraph:
             vrng = None if rng is None else _rng.fold_name(rng, name)
             out, st = self._apply_vertex(name, params[name], acts,
                                          states[name], vrng, train=train,
-                                         in_masks=in_masks)
+                                         in_masks=in_masks,
+                                         minibatch=mbs[in_names[0]])
             acts[name] = out
             mask_map[name] = self.conf.vertices[name].output_mask(
                 in_masks, minibatch=acts[in_names[0]].shape[0])
@@ -160,16 +176,19 @@ class ComputationGraph:
         return acts, new_states
 
     def _apply_vertex(self, name, params_n, local_acts, state_n, vrng, *,
-                      train, in_masks=None):
+                      train, in_masks=None, minibatch=None):
         """Gather inputs + apply for one vertex — the single definition of
         per-vertex forward semantics, shared by the plain and
-        remat-segmented paths (so they cannot drift)."""
+        remat-segmented paths (so they cannot drift). ``minibatch`` is the
+        NETWORK batch size (time-flattened activations make x.shape[0]
+        wrong for shape-rebuilding preprocessors)."""
         v = self.conf.vertices[name]
         xs = [local_acts[i] for i in self.conf.vertex_inputs[name]]
         if in_masks is None:
             in_masks = [None] * len(xs)
         out, st = v.apply(params_n, xs, state=state_n, train=train,
-                          rng=vrng, masks=in_masks, policy=self.policy)
+                          rng=vrng, masks=in_masks, policy=self.policy,
+                          minibatch=minibatch)
         return out, (st if st is not None else {})
 
     def _segment_plan(self):
@@ -225,6 +244,7 @@ class ComputationGraph:
         standard memory/compute trade (brief: jax.checkpoint for HBM).
         Masked inputs fall back to the unsegmented path (mask plumbing is
         host-side Python, incompatible with a traced segment boundary)."""
+        mbs = self._minibatch_map(inputs[0].shape[0])
         acts: Dict[str, jax.Array] = dict(
             zip(self.conf.network_inputs, inputs))
         segments, skip = self._segment_plan()
@@ -244,7 +264,8 @@ class ComputationGraph:
                 for vname in _seg:
                     out, vst = self._apply_vertex(
                         vname, p[vname], local, st[vname], rngs[vname],
-                        train=True)
+                        train=True,
+                        minibatch=mbs[self.conf.vertex_inputs[vname][0]])
                     local[vname] = out
                     st_out[vname] = vst
                 return [local[o] for o in _outs], st_out
@@ -356,6 +377,7 @@ class ComputationGraph:
         # publish their activation (reference ComputationGraph supports output
         # layers with consumers); XLA CSE merges the duplicated layer forward
         consumed = {i for ins in self.conf.vertex_inputs.values() for i in ins}
+        mbs = self._minibatch_map(inputs[0].shape[0])
         total = 0.0
         for name in self.topo_order:
             in_names = self.conf.vertex_inputs[name]
@@ -365,11 +387,13 @@ class ComputationGraph:
             if is_out:
                 total = total + self._output_score(
                     params, name, acts[in_names[0]], label_map[name],
-                    in_masks[0] if in_masks else None, vrng)
+                    in_masks[0] if in_masks else None, vrng,
+                    minibatch=mbs[in_names[0]])
             if not is_out or name in consumed:
                 out, st = self._apply_vertex(name, params[name], acts,
                                              states[name], vrng, train=True,
-                                             in_masks=in_masks)
+                                             in_masks=in_masks,
+                                             minibatch=mbs[in_names[0]])
                 acts[name] = out
                 mask_map[name] = self.conf.vertices[name].output_mask(
                     in_masks, minibatch=acts[in_names[0]].shape[0])
@@ -381,7 +405,8 @@ class ComputationGraph:
                       else jnp.float32)
         return total.astype(loss_dtype), new_states
 
-    def _output_score(self, params, name, hidden, y, mask, vrng=None):
+    def _output_score(self, params, name, hidden, y, mask, vrng=None,
+                      minibatch=None):
         """One output vertex's loss contribution from its HIDDEN input —
         preprocessor, fused score array, masked denominator. Shared by the
         plain and gradient-checkpointed loss paths. ``vrng`` is this
@@ -391,7 +416,7 @@ class ComputationGraph:
         v = self.conf.vertices[name]
         out_mask = mask
         if v.preprocessor is not None:
-            mb = hidden.shape[0]
+            mb = minibatch if minibatch is not None else hidden.shape[0]
             hidden = call_preprocessor(v.preprocessor, hidden,
                                        minibatch_size=mb, rng=vrng)
             out_mask = v.preprocessor.transform_mask(out_mask,
@@ -409,12 +434,14 @@ class ComputationGraph:
         acts, new_states = self._forward_segmented(params, states, inputs,
                                                    rng=rng)
         label_map = dict(zip(self.conf.network_outputs, labels))
+        mbs = self._minibatch_map(inputs[0].shape[0])
         total = 0.0
         for name in self._output_layer_names:
             hidden = acts[self.conf.vertex_inputs[name][0]]
             vrng = None if rng is None else _rng.fold_name(rng, name)
-            total = total + self._output_score(params, name, hidden,
-                                               label_map[name], None, vrng)
+            total = total + self._output_score(
+                params, name, hidden, label_map[name], None, vrng,
+                minibatch=mbs[self.conf.vertex_inputs[name][0]])
         total = total + self._reg_penalty(params)
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)
@@ -736,7 +763,10 @@ class ComputationGraph:
                 x = acts[self.conf.vertex_inputs[name][0]]
                 v = self.conf.vertices[name]
                 if v.preprocessor is not None:
-                    x = v.preprocessor(x, minibatch_size=x.shape[0])
+                    mbs = self._minibatch_map(inputs[0].shape[0])
+                    x = v.preprocessor(
+                        x,
+                        minibatch_size=mbs[self.conf.vertex_inputs[name][0]])
                 return x
             self._jit_cache[f"pre_acts_{name}"] = fn
         return fn(self.params, self._states_map(), inputs)

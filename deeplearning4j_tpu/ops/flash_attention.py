@@ -138,8 +138,13 @@ def _flash_fwd_btd(qt, kt, vt, *, scale, causal, block_q, interpret,
                    block_k: int = 512):
     """[bh, t, d] inputs → ([bh, t, d] out, [bh, t] lse)."""
     bh, t, d = qt.shape
+    if t % block_q:
+        raise ValueError(
+            f"flash_attention needs t % block_q == 0 (t={t}, "
+            f"block_q={block_q}) — unwritten tail blocks would return "
+            "uninitialized memory; use the XLA path for ragged lengths")
     if t % block_k:
-        block_k = block_q      # t % block_q == 0 guaranteed by the router
+        block_k = block_q
     nk = t // block_k
     # lse rides as [bh, t, 1]: TPU block shapes need the last two dims
     # (8, 128)-aligned or full — (block_q, 1) satisfies that, (1, block_q)

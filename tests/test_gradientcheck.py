@@ -228,3 +228,18 @@ class TestHarnessCatchesErrors:
         r = _check_loss_fn(lambda p: bad_square(p["w"]), params,
                            1e-6, 1e-5, 1e-9, None, 0)
         assert not r.passed
+
+
+class TestLayerNormGradients:
+    def test_layer_norm(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+        x = rng.normal(size=(6, 5))
+        y = _class_labels(rng, 6, 3)
+        conf = (_builder().list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(LayerNormalization())
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        r = check_gradients(conf, x, y, max_rel_error=MAX_REL)
+        assert r.passed, r.summary()

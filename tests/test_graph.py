@@ -432,3 +432,44 @@ class TestGraphYamlSerde:
         a = np.asarray(ComputationGraph(conf).init().output([x]))
         b = np.asarray(ComputationGraph(conf2).init().output([x]))
         assert np.allclose(a, b)
+
+
+class TestBatchAxisMinibatchTracking:
+    def test_stack_then_time_rebuild_uses_stacked_batch(self, rng):
+        """FeedForwardToRnn downstream of a StackVertex must rebuild with
+        the STACKED example count (2b), not the network input batch (code
+        review r4 — a global-minibatch shortcut silently merged the two
+        towers into double-length sequences)."""
+        from deeplearning4j_tpu.nn.conf.graph import (PreprocessorVertex,
+                                                      StackVertex)
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            FeedForwardToRnnPreProcessor)
+        from deeplearning4j_tpu.nn.conf.recurrent import LastTimeStepLayer
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = (_base().graph_builder()
+                .add_inputs("x1", "x2")
+                .add_vertex("stack", StackVertex(), "x1", "x2")
+                .add_layer("d", DenseLayer(n_out=6, activation="tanh"),
+                           "stack")
+                .add_vertex("to_rnn",
+                            PreprocessorVertex(FeedForwardToRnnPreProcessor()),
+                            "d")
+                .add_layer("last", LastTimeStepLayer(), "to_rnn")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "last")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(4),
+                                 InputType.recurrent(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        b, t = 3, 5
+        x1 = rng.normal(size=(b, t, 4)).astype(np.float32)
+        x2 = rng.normal(size=(b, t, 4)).astype(np.float32)
+        acts = net.feed_forward([x1, x2])
+        assert acts["to_rnn"].shape == (2 * b, t, 6)   # NOT (b, 2t, 6)
+        assert acts["out"].shape == (2 * b, 3)
+        # tower independence: x2 must not bleed into x1's half
+        acts2 = net.feed_forward([x1, rng.normal(size=(b, t, 4))
+                                  .astype(np.float32)])
+        assert np.allclose(np.asarray(acts["out"])[:b],
+                           np.asarray(acts2["out"])[:b], atol=1e-6)
