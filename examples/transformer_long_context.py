@@ -5,7 +5,12 @@ transformer built from the config DSL whose attention auto-routes to the
 Pallas flash kernel at long sequence lengths, plus the same model trained
 with the TIME axis sharded over a device mesh (ring attention).
 
-Run: python examples/transformer_long_context.py [--smoke]
+The flash-kernel auto-routing needs a TPU backend; elsewhere the full-size
+run takes the dense XLA path (slow/OOM-prone at T=4096 — that is the
+point). The sequence-parallel half needs >1 device; on a CPU-only
+machine simulate a mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python examples/transformer_long_context.py --smoke
 """
 import sys
 
@@ -24,7 +29,9 @@ def cyclic_batch(vocab, batch, t):
 
 def main(smoke: bool = False):
     V = 8
-    T, steps = (16, 60) if smoke else (4096, 200)  # T>=4096 → flash kernel
+    # T>=4096 routes attention to the flash kernel ON TPU (see
+    # ops/flash_attention.flash_available); smoke stays tiny for CI
+    T, steps = (16, 60) if smoke else (4096, 200)
     net = ComputationGraph(transformer_lm(
         V, n_layers=2, d_model=32 if smoke else 256,
         n_heads=2 if smoke else 4, d_ff=64 if smoke else 1024,
@@ -44,7 +51,11 @@ def main(smoke: bool = False):
     from deeplearning4j_tpu.parallel import create_mesh
     from deeplearning4j_tpu.parallel.sequence import SequenceParallelTrainer
     n = jax.device_count()
-    if n > 1:
+    if n == 1:
+        print("sequence-parallel half skipped: 1 device (simulate a mesh "
+              "with XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              "JAX_PLATFORMS=cpu)")
+    else:
         tr = SequenceParallelTrainer(d_model=16, d_ff=32, n_heads=2,
                                      vocab=V, mesh=create_mesh({"seq": n}),
                                      learning_rate=0.5, seed=1)
