@@ -25,15 +25,15 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
 
     mask: optional [b, t_kv] key-validity mask (1=attend).
 
-    Unmasked calls route to the Pallas flash kernel
-    (``ops.flash_attention``) automatically at t ≥ 4096 on TPU — forward
-    AND blockwise backward, ≥2× measured (PERF.md).
+    Calls route to the Pallas flash kernel (``ops.flash_attention``,
+    key masks included) automatically at t ≥ 4096 on TPU — forward AND
+    blockwise backward, ≥2× measured (PERF.md).
     ``DL4JTPU_FLASH_ATTENTION=1`` forces the kernel at any length, ``0``
     forces this XLA path."""
     from .flash_attention import flash_attention, flash_available
     if q.ndim == 4 and q.shape == k.shape == v.shape \
             and flash_available(q.shape, mask):
-        return flash_attention(q, k, v, causal, scale)
+        return flash_attention(q, k, v, causal, scale, mask=mask)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

@@ -8,6 +8,11 @@ whole-K/V-in-VMEM with a dynamic fori_loop that SKIPS post-diagonal blocks
 (O(block) VMEM) for longer sequences. The kernel also emits the per-row
 log-sum-exp, which makes the backward blockwise too.
 
+Key-validity masks ([b, t_kv], 1=attend) are supported: masked keys get
+NEG_INF logits, and rows with NO attendable keys (leading padding under a
+causal mask, all-zero mask rows) output 0 — same semantics as the guarded
+XLA path in ``ops.attention``.
+
 Backward: the standard flash backward over [512, 512] tiles — P is
 recomputed from the saved lse; the dq pass is vmapped over q-blocks (scan
 over k), the dk/dv pass vmapped over k-blocks (scan over q). Peak memory
@@ -20,8 +25,8 @@ the single source of truth): forward 1.8-2.8× over the XLA fused path at
 t≥4096, backward 1.6×-parity, and t=16384 runs fwd+bwd where XLA OOMs.
 
 Routing (``ops.attention.dot_product_attention``): auto at t ≥ 4096 on
-the TPU backend with no key mask; ``DL4JTPU_FLASH_ATTENTION=1`` forces it
-on (any length), ``0`` forces the XLA path.
+the TPU backend; ``DL4JTPU_FLASH_ATTENTION=1`` forces it on (any length),
+``0`` forces the XLA path.
 """
 
 from __future__ import annotations
@@ -35,6 +40,45 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_HALF_NEG = NEG_INF / 2
+# whole-K/V-in-VMEM variant above this size switches to the grid-streamed
+# kernel (module constant so tests can force the streamed path)
+_VMEM_KV_LIMIT = 4 * 1024 * 1024
+
+
+def _masked_update(q, k, v, valid, m_prev, num, den, *, scale, causal,
+                   block_q, block_k, q_offset, k_offset):
+    """One online-softmax block update with NEG_INF-sentinel guards:
+    rows whose running max is still NEG_INF (no attendable key yet)
+    contribute exactly zero — so fully-masked rows end at num=den=0."""
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [bq, bk]
+    logits = jnp.where(valid, logits, NEG_INF)   # valid: [1, bk] bool
+    if causal:
+        rows = q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = k_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        logits = jnp.where(rows >= cols, logits, NEG_INF)
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
+    m_safe = jnp.where(m_new <= _HALF_NEG, 0.0, m_new)
+    p = jnp.where(logits <= _HALF_NEG, 0.0,
+                  jnp.exp(logits - m_safe[:, None]))
+    corr = jnp.where(m_prev <= _HALF_NEG, 0.0,
+                     jnp.exp(m_prev - m_safe))
+    num = num * corr[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    den = den * corr + jnp.sum(p, axis=-1)
+    return m_new, num, den
+
+
+def _finalize(m, num, den):
+    """(out, lse) from the accumulators; 0-key rows → out 0, lse NEG_INF."""
+    out = num / jnp.maximum(den, 1e-30)[:, None]
+    lse = jnp.where(den > 0, m + jnp.log(jnp.maximum(den, 1e-30)), NEG_INF)
+    return out, lse
 
 
 # --------------------------------------------------------------------------
@@ -42,8 +86,8 @@ NEG_INF = -1e30
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel_vmem(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                     block_q, block_k):
+def _fwd_kernel_vmem(q_ref, k_ref, v_ref, mk_ref, o_ref, lse_ref, *,
+                     scale, causal, block_q, block_k):
     """Whole-K/V-in-VMEM variant: one DMA brings K/V in, then a fori_loop
     over k-blocks runs the online softmax. The dynamic loop bound skips
     post-diagonal blocks entirely (loads and compute) when causal."""
@@ -51,28 +95,16 @@ def _fwd_kernel_vmem(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     q = q_ref[0].astype(jnp.float32)                  # [block_q, d]
     t = k_ref.shape[1]
     d = q.shape[-1]
-    rows = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
 
     def body(j, carry):
         m_prev, num, den = carry
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            logits = jnp.where(rows >= cols, logits, NEG_INF)
-        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
-        p = jnp.exp(logits - m_new[:, None])
-        corr = jnp.exp(m_prev - m_new)
-        num = num * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        den = den * corr + jnp.sum(p, axis=-1)
-        return m_new, num, den
+        valid = mk_ref[0, pl.ds(j, 1), :] > 0     # [1, block_k]
+        return _masked_update(q, k, v, valid, m_prev, num, den,
+                              scale=scale, causal=causal, block_q=block_q,
+                              block_k=block_k, q_offset=qi * block_q,
+                              k_offset=j * block_k)
 
     if causal:
         nk = (qi * block_q + block_q + block_k - 1) // block_k
@@ -82,12 +114,14 @@ def _fwd_kernel_vmem(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             jnp.zeros((block_q, d), jnp.float32),
             jnp.zeros((block_q,), jnp.float32))
     m, num, den = jax.lax.fori_loop(0, nk, body, init)
-    o_ref[0] = (num / den[:, None]).astype(o_ref.dtype)
-    lse_ref[0, :, 0] = m + jnp.log(den)
+    out, lse = _finalize(m, num, den)
+    o_ref[0] = out.astype(o_ref.dtype)
+    lse_ref[0, :, 0] = lse
 
 
-def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, num_s,
-                       den_s, *, scale, causal, block_q, block_k, nk):
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, mk_ref, o_ref, lse_ref, m_s,
+                       num_s, den_s, *, scale, causal, block_q, block_k,
+                       nk):
     """Grid-streamed variant: pallas double-buffers K/V blocks through
     VMEM; online-softmax accumulators persist in VMEM scratch across the
     (sequential) k dimension of the grid."""
@@ -108,35 +142,27 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, num_s,
         q = q_ref[0].astype(jnp.float32)              # [bq, d]
         k = k_ref[0].astype(jnp.float32)              # [bk, d]
         v = v_ref[0].astype(jnp.float32)              # [bk, d]
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            logits = jnp.where(rows >= cols, logits, NEG_INF)
-        m_prev = m_s[...][:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
-        p = jnp.exp(logits - m_new[:, None])
-        corr = jnp.exp(m_prev - m_new)
-        num_s[...] = num_s[...] * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        den_s[...] = den_s[...] * corr[:, None] + jnp.sum(
-            p, axis=-1, keepdims=True)
-        m_s[...] = m_new[:, None]
+        valid = mk_ref[0, pl.ds(kj, 1), :] > 0    # [1, block_k]
+        m, num, den = _masked_update(
+            q, k, v, valid, m_s[...][:, 0], num_s[...], den_s[...][:, 0],
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            q_offset=qi * block_q, k_offset=kj * block_k)
+        m_s[...] = m[:, None]
+        num_s[...] = num
+        den_s[...] = den[:, None]
 
     @pl.when(kj == nk - 1)
-    def _finalize():
-        o_ref[0] = (num_s[...] / den_s[...]).astype(o_ref.dtype)
-        lse_ref[0] = m_s[...] + jnp.log(den_s[...])
+    def _final():
+        out, lse = _finalize(m_s[...][:, 0], num_s[...], den_s[...][:, 0])
+        o_ref[0] = out.astype(o_ref.dtype)
+        lse_ref[0, :, 0] = lse
 
 
-def _flash_fwd_btd(qt, kt, vt, *, scale, causal, block_q, interpret,
-                   block_k: int = 512):
-    """[bh, t, d] inputs → ([bh, t, d] out, [bh, t] lse)."""
+def _flash_fwd_btd(qt, kt, vt, mask_bt, *, n_heads, scale, causal,
+                   block_q, interpret, block_k: int = 512):
+    """[bh, t, d] q/k/v + [b, t] key mask → ([bh, t, d] out, [bh, t] lse).
+    The mask is NOT head-folded: index maps read row ``bh // n_heads``, so
+    one [b, ...] mask array serves every head."""
     bh, t, d = qt.shape
     if t % block_q:
         raise ValueError(
@@ -146,13 +172,25 @@ def _flash_fwd_btd(qt, kt, vt, *, scale, causal, block_q, interpret,
     if t % block_k:
         block_k = block_q
     nk = t // block_k
+    # mask rides pre-blocked as [b, t//block_k, block_k]: each kernel step
+    # slices one native (1, block_k) row — no vector reshapes (Mosaic
+    # rejects rank changes), no lane padding ([bh, t, 1] OOM'd VMEM), no
+    # lane-dim dynamic slicing ([bh, 1, t] measured ~10x slower). Both
+    # variants take the FULL per-batch-row mask block (t floats — trivially
+    # VMEM-resident) because a (1, 1, block_k) partial block would violate
+    # the (8, 128)-or-full tiling rule on the middle dim.
+    nkb = t // block_k
+    mkt = mask_bt.astype(jnp.float32).reshape(-1, nkb, block_k)
+    h_ = n_heads
     # lse rides as [bh, t, 1]: TPU block shapes need the last two dims
     # (8, 128)-aligned or full — (block_q, 1) satisfies that, (1, block_q)
     # does not
     out_shapes = (jax.ShapeDtypeStruct((bh, t, d), qt.dtype),
                   jax.ShapeDtypeStruct((bh, t, 1), jnp.float32))
+    out_specs = (pl.BlockSpec((1, block_q, d), lambda b, i, *j: (b, i, 0)),
+                 pl.BlockSpec((1, block_q, 1), lambda b, i, *j: (b, i, 0)))
     kv_bytes = 2 * t * d * qt.dtype.itemsize
-    if kv_bytes <= 4 * 1024 * 1024:
+    if kv_bytes <= _VMEM_KV_LIMIT:
         kernel = functools.partial(_fwd_kernel_vmem, scale=scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k)
@@ -163,14 +201,13 @@ def _flash_fwd_btd(qt, kt, vt, *, scale, causal, block_q, interpret,
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, nkb, block_k),
+                             lambda b, i: (b // h_, 0, 0)),
             ],
-            out_specs=(
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            ),
+            out_specs=out_specs,
             out_shape=out_shapes,
             interpret=interpret,
-        )(qt, kt, vt)
+        )(qt, kt, vt, mkt)
         return out, lse[..., 0]
     kernel = functools.partial(_fwd_kernel_stream, scale=scale,
                                causal=causal, block_q=block_q,
@@ -182,11 +219,9 @@ def _flash_fwd_btd(qt, kt, vt, *, scale, causal, block_q, interpret,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, nkb, block_k), lambda b, i, j: (b // h_, 0, 0)),
         ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ),
+        out_specs=out_specs,
         out_shape=out_shapes,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),    # running max
@@ -194,7 +229,7 @@ def _flash_fwd_btd(qt, kt, vt, *, scale, causal, block_q, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),    # denominator
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(qt, kt, vt, mkt)
     return out, lse[..., 0]
 
 
@@ -203,14 +238,14 @@ def _flash_fwd_btd(qt, kt, vt, *, scale, causal, block_q, interpret,
 # --------------------------------------------------------------------------
 
 
-def _flash_bwd_btd(q, k, v, out, lse, dout, *, scale, causal, block_q,
+def _flash_bwd_btd(q, k, v, mk, out, lse, dout, *, scale, causal, block_q,
                    block_k):
-    """[bh, t, d] grads with O(block² + t·d) peak memory.
+    """[bh, t, d] grads with O(t·block + t·d) peak memory.
 
     Standard flash backward: P recomputed per tile from the saved lse,
-    dS = P ∘ (dout·vᵀ − Δ), Δ = rowsum(dout ∘ out). Outer scan over
-    q-blocks carries the dk/dv accumulators; inner scan over k-blocks
-    touches one [block_q, block_k] tile at a time."""
+    dS = P ∘ (dout·vᵀ − Δ), Δ = rowsum(dout ∘ out). Two passes, each
+    parallel (vmapped) over one block axis and sequential over the other,
+    so XLA batches the tile matmuls instead of serializing them."""
     bh, t, d = q.shape
     if t % block_k:
         block_k = block_q
@@ -222,10 +257,14 @@ def _flash_bwd_btd(q, k, v, out, lse, dout, *, scale, causal, block_q,
     r_iota = jnp.arange(block_q)
     c_iota = jnp.arange(block_k)
 
-    def _p_ds(qi, kj, vj, doi, lsei, deltai, i0, j0):
-        """Recompute one [block_q, block_k] tile's P and dS."""
+    def _p_ds(qi, kj, vj, mj, doi, lsei, deltai, i0, j0):
+        """Recompute one [block_q, block_k] tile's P and dS. Rows with
+        lse=NEG_INF (no attendable keys) get P=0, not exp(overflow)."""
         s = jnp.dot(qi, kj.T, preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lsei[:, None])
+        lse_safe = jnp.where(lsei <= _HALF_NEG, 0.0, lsei)
+        p = jnp.where((lsei <= _HALF_NEG)[:, None], 0.0,
+                      jnp.exp(s - lse_safe[:, None]))
+        p = jnp.where((mj > 0)[None, :], p, 0.0)
         if causal:
             allow = (i0 + r_iota)[:, None] >= (j0 + c_iota)[None, :]
             p = jnp.where(allow, p, 0.0)
@@ -233,33 +272,31 @@ def _flash_bwd_btd(q, k, v, out, lse, dout, *, scale, causal, block_q,
         ds = p * (dp - deltai[:, None]) * scale
         return p, ds
 
-    def per_head(q, k, v, lse, delta, dout):
-        # two passes, each parallel (vmapped) over one block axis and
-        # sequential over the other — no [t, d] accumulator rides a scan
-        # carry, so XLA batches the tile matmuls instead of serializing
+    def per_head(q, k, v, mk, lse, delta, dout):
         q_r = f32(q).reshape(nq, block_q, d)
         k_r = f32(k).reshape(nk, block_k, d)
         v_r = f32(v).reshape(nk, block_k, d)
+        m_r = f32(mk).reshape(nk, block_k)
         do_r = f32(dout).reshape(nq, block_q, d)
         lse_r = lse.reshape(nq, block_q)
         dl_r = delta.reshape(nq, block_q)
 
         def dq_block(qi, doi, lsei, deltai, i0):
             def over_j(dqi, xs):
-                kj, vj, j0 = xs
-                _, ds = _p_ds(qi, kj, vj, doi, lsei, deltai, i0, j0)
+                kj, vj, mj, j0 = xs
+                _, ds = _p_ds(qi, kj, vj, mj, doi, lsei, deltai, i0, j0)
                 return dqi + jnp.dot(ds, kj,
                                      preferred_element_type=jnp.float32), None
             dqi, _ = jax.lax.scan(over_j,
                                   jnp.zeros((block_q, d), jnp.float32),
-                                  (k_r, v_r, j_base))
+                                  (k_r, v_r, m_r, j_base))
             return dqi
 
-        def dkv_block(kj, vj, j0):
+        def dkv_block(kj, vj, mj, j0):
             def over_i(carry, xs):
                 dkj, dvj = carry
                 qi, doi, lsei, deltai, i0 = xs
-                p, ds = _p_ds(qi, kj, vj, doi, lsei, deltai, i0, j0)
+                p, ds = _p_ds(qi, kj, vj, mj, doi, lsei, deltai, i0, j0)
                 dkj = dkj + jnp.dot(ds.T, qi,
                                     preferred_element_type=jnp.float32)
                 dvj = dvj + jnp.dot(p.T, doi,
@@ -272,10 +309,10 @@ def _flash_bwd_btd(q, k, v, out, lse, dout, *, scale, causal, block_q,
             return dkj, dvj
 
         dq = jax.vmap(dq_block)(q_r, do_r, lse_r, dl_r, i_base)
-        dk, dv = jax.vmap(dkv_block)(k_r, v_r, j_base)
+        dk, dv = jax.vmap(dkv_block)(k_r, v_r, m_r, j_base)
         return (dq.reshape(t, d), dk.reshape(t, d), dv.reshape(t, d))
 
-    dq, dk, dv = jax.vmap(per_head)(q, k, v, lse, delta, dout)
+    dq, dk, dv = jax.vmap(per_head)(q, k, v, mk, lse, delta, dout)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -284,51 +321,60 @@ def _flash_bwd_btd(q, k, v, out, lse, dout, *, scale, causal, block_q,
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    interpret=False):
-    """[b, t, h, d] attention with the Pallas forward and blockwise
-    backward. t must divide by ``block_q``. No key-mask support — masked
-    calls use the XLA path (see ``dot_product_attention``)."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, interpret)
-    return out
-
-
 def _resolve_scale(scale, d):
     return scale if scale is not None else 1.0 / float(d) ** 0.5
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core(q, k, v, mask, causal, scale, block_q, interpret):
+    out, _ = _core_fwd(q, k, v, mask, causal, scale, block_q, interpret)
+    return out
+
+
+def _core_fwd(q, k, v, mask, causal, scale, block_q, interpret):
     b, t, h, d = q.shape
     s = _resolve_scale(scale, d)
     to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    out, lse = _flash_fwd_btd(to_btd(q), to_btd(k), to_btd(v), scale=s,
-                              causal=causal, block_q=block_q,
-                              interpret=interpret)
+    out, lse = _flash_fwd_btd(to_btd(q), to_btd(k), to_btd(v), mask,
+                              n_heads=h, scale=s, causal=causal,
+                              block_q=block_q, interpret=interpret)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
 
 
-def _fwd_rule(q, k, v, causal, scale, block_q, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, interpret)
-    return out, (q, k, v, out, lse)
+def _core_fwd_rule(q, k, v, mask, causal, scale, block_q, interpret):
+    out, lse = _core_fwd(q, k, v, mask, causal, scale, block_q, interpret)
+    return out, (q, k, v, mask, out, lse)
 
 
-def _bwd_rule(causal, scale, block_q, interpret, res, g):
-    q, k, v, out, lse = res
+def _core_bwd_rule(causal, scale, block_q, interpret, res, g):
+    q, k, v, mask, out, lse = res
     b, t, h, d = q.shape
     s = _resolve_scale(scale, d)
     to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    mk = jnp.repeat(mask.astype(jnp.float32), h, axis=0)
     # backward tiles are independent of the forward block size; 512-wide
     # tiles keep the MXU busy (128-row tiles measured ~1.5× slower)
     bq_bwd = 512 if t % 512 == 0 else block_q
     dq, dk, dv = _flash_bwd_btd(
-        to_btd(q), to_btd(k), to_btd(v), to_btd(out), lse, to_btd(g),
+        to_btd(q), to_btd(k), to_btd(v), mk, to_btd(out), lse, to_btd(g),
         scale=s, causal=causal, block_q=bq_bwd, block_k=512)
     back = lambda a: a.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    return back(dq), back(dk), back(dv)
+    return back(dq), back(dk), back(dv), jnp.zeros_like(mask,
+                                                        dtype=jnp.float32)
 
 
-flash_attention.defvjp(_fwd_rule, _bwd_rule)
+_flash_core.defvjp(_core_fwd_rule, _core_bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    interpret=False, mask=None):
+    """[b, t, h, d] attention with the Pallas forward and blockwise
+    backward. t must divide by ``block_q``. ``mask``: optional [b, t_kv]
+    key-validity mask (1=attend); rows with no attendable keys output 0."""
+    if mask is None:
+        mask = jnp.ones((q.shape[0], q.shape[1]), jnp.float32)
+    return _flash_core(q, k, v, jnp.asarray(mask, jnp.float32), causal,
+                       scale, block_q, interpret)
 
 
 def flash_available(q_shape, mask, block_q: int = 128) -> bool:
@@ -337,12 +383,14 @@ def flash_available(q_shape, mask, block_q: int = 128) -> bool:
     ``DL4JTPU_FLASH_ATTENTION``: ``1`` forces it on, ``0`` off; unset =
     auto — on for t ≥ 4096 on the TPU backend (where it measures ≥2× over
     the XLA path on v5e; below that XLA's fusion already sits at the
-    memory floor). Key masks and non-multiple-of-block lengths always use
-    the XLA path."""
+    memory floor). Non-multiple-of-block lengths always use the XLA path."""
     import os
     flag = os.environ.get("DL4JTPU_FLASH_ATTENTION", "auto")
-    if flag == "0" or mask is not None or q_shape[1] % block_q:
+    if flag == "0" or q_shape[1] % block_q:
         return False
+    if mask is not None and getattr(mask, "shape", None) is not None \
+            and tuple(mask.shape) != (q_shape[0], q_shape[1]):
+        return False   # only [b, t_kv] key masks map onto the kernel
     if flag == "1":
         return True
     return q_shape[1] >= 4096 and jax.devices()[0].platform == "tpu"

@@ -23,12 +23,42 @@ def _qkv(rng, b=2, t=256, h=2, d=64):
     return mk(), mk(), mk()
 
 
+def _ragged_mask(b, t, lengths):
+    m = np.zeros((b, t), np.float32)
+    for i, l in enumerate(lengths):
+        m[i, :l] = 1.0
+    return jnp.asarray(m)
+
+
 class TestFlashAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_forward_matches_dense(self, rng, causal):
         q, k, v = _qkv(rng)
         ref = np.asarray(dot_product_attention(q, k, v, causal=causal))
         out = np.asarray(flash_attention(q, k, v, causal, None, 128, True))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_masked_forward_matches_dense(self, rng, causal):
+        q, k, v = _qkv(rng)
+        mask = _ragged_mask(2, 256, [200, 131])
+        ref = np.asarray(dot_product_attention(q, k, v, causal=causal,
+                                               mask=mask))
+        out = np.asarray(flash_attention(q, k, v, causal, None, 128, True,
+                                         mask=mask))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_leading_padding_causal_outputs_zero(self, rng):
+        # query steps with NO attendable keys must output 0, not NaN
+        q, k, v = _qkv(rng, t=128)
+        mask = np.ones((2, 128), np.float32)
+        mask[:, :5] = 0.0
+        out = np.asarray(flash_attention(q, k, v, True, None, 128, True,
+                                         mask=jnp.asarray(mask)))
+        assert np.all(np.isfinite(out))
+        assert np.allclose(out[:, :5], 0.0)
+        ref = np.asarray(dot_product_attention(q, k, v, causal=True,
+                                               mask=jnp.asarray(mask)))
         np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
     @pytest.mark.parametrize("causal", [False, True])
@@ -45,12 +75,26 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
 
+    def test_masked_gradients_match_dense(self, rng):
+        q, k, v = _qkv(rng, t=256)
+        mask = _ragged_mask(2, 256, [256, 170])
+        loss_f = lambda f: lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+        g_ref = jax.grad(loss_f(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, mask=mask)), argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_f(lambda q, k, v: flash_attention(
+            q, k, v, True, None, 128, True, mask=mask)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
     def test_lse_is_correct(self, rng):
         q, k, v = _qkv(rng, t=128)
         b, t, h, d = q.shape
         to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-        _, lse = fa._flash_fwd_btd(to_btd(q), to_btd(k), to_btd(v),
-                                   scale=d ** -0.5, causal=True,
+        mk = jnp.ones((b, t), jnp.float32)
+        _, lse = fa._flash_fwd_btd(to_btd(q), to_btd(k), to_btd(v), mk,
+                                   n_heads=h, scale=d ** -0.5, causal=True,
                                    block_q=128, interpret=True)
         logits = jnp.einsum("btd,bsd->bts", to_btd(q), to_btd(k)) * d ** -0.5
         cm = jnp.tril(jnp.ones((t, t), bool))
@@ -65,45 +109,33 @@ class TestFlashAttention:
         assert not flash_available(q.shape, None)
         monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "1")
         assert flash_available(q.shape, None)
-        assert not flash_available(q.shape, np.ones((2, 256)))  # masked
-        assert not flash_available((2, 250, 2, 64), None)       # t % block
+        assert flash_available(q.shape, np.ones((2, 256)))   # key masks ok
+        assert not flash_available(q.shape, np.ones((2, 9)))  # odd mask shape
+        assert not flash_available((2, 250, 2, 64), None)     # t % block
         # auto: long sequences only, and only on a real TPU backend
         monkeypatch.delenv("DL4JTPU_FLASH_ATTENTION")
         assert not flash_available((2, 256, 2, 64), None)
-        assert not flash_available((2, 4096, 2, 64), None)      # cpu tests
+        assert not flash_available((2, 4096, 2, 64), None)    # cpu tests
 
-    def test_streamed_variant_matches_dense(self, rng):
-        # the long-sequence streamed kernel, called directly (its VMEM
-        # threshold is impractical to cross in interpret mode)
+    @pytest.mark.parametrize("masked", [False, True])
+    def test_streamed_variant_matches_dense(self, rng, masked, monkeypatch):
+        # force the long-sequence streamed kernel by shrinking the VMEM
+        # dispatch threshold; run it with and without a ragged mask
+        monkeypatch.setattr(fa, "_VMEM_KV_LIMIT", 0)
         q, k, v = _qkv(rng, t=256)
-        qt = q.transpose(0, 2, 1, 3).reshape(-1, 256, 64)
-        kt = k.transpose(0, 2, 1, 3).reshape(-1, 256, 64)
-        vt = v.transpose(0, 2, 1, 3).reshape(-1, 256, 64)
-        kernel = functools.partial(fa._fwd_kernel_stream, scale=0.125,
-                                   causal=True, block_q=128, block_k=128,
-                                   nk=2)
-        out, lse = pl.pallas_call(
-            kernel, grid=(qt.shape[0], 2, 2),
-            in_specs=[
-                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, j, 0)),
-            ],
-            out_specs=(
-                pl.BlockSpec((1, 128, 64), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, 128, 1), lambda b, i, j: (b, i, 0)),
-            ),
-            out_shape=(jax.ShapeDtypeStruct(qt.shape, qt.dtype),
-                       jax.ShapeDtypeStruct(qt.shape[:2] + (1,),
-                                            jnp.float32)),
-            scratch_shapes=[pltpu.VMEM((128, 1), jnp.float32),
-                            pltpu.VMEM((128, 64), jnp.float32),
-                            pltpu.VMEM((128, 1), jnp.float32)],
-            interpret=True)(qt, kt, vt)
-        out = np.asarray(out).reshape(2, 2, 256, 64).transpose(0, 2, 1, 3)
+        mask = _ragged_mask(2, 256, [190, 131]) if masked else None
         ref = np.asarray(dot_product_attention(q, k, v, causal=True,
-                                               scale=0.125))
+                                               mask=mask))
+        out = np.asarray(flash_attention(q, k, v, True, None, 128, True,
+                                         mask=mask))
         np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+        # backward through the streamed forward (lse path) too
+        g_ref = jax.grad(lambda q: jnp.sum(dot_product_attention(
+            q, k, v, causal=True, mask=mask) ** 2))(q)
+        g_fl = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, True, None, 128, True, mask=mask) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref),
+                                   rtol=2e-4, atol=2e-4)
 
     def test_wide_block_backward_matches_dense(self, rng):
         # t divisible by 512 engages the 512-wide backward tiles
