@@ -179,8 +179,7 @@ def _flash_fwd_btd(qt, kt, vt, mask_bt, *, n_heads, scale, causal,
     # variants take the FULL per-batch-row mask block (t floats — trivially
     # VMEM-resident) because a (1, 1, block_k) partial block would violate
     # the (8, 128)-or-full tiling rule on the middle dim.
-    nkb = t // block_k
-    mkt = mask_bt.astype(jnp.float32).reshape(-1, nkb, block_k)
+    mkt = mask_bt.astype(jnp.float32).reshape(-1, nk, block_k)
     h_ = n_heads
     # lse rides as [bh, t, 1]: TPU block shapes need the last two dims
     # (8, 128)-aligned or full — (block_q, 1) satisfies that, (1, block_q)
@@ -201,7 +200,7 @@ def _flash_fwd_btd(qt, kt, vt, mask_bt, *, n_heads, scale, causal,
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, nkb, block_k),
+                pl.BlockSpec((1, nk, block_k),
                              lambda b, i: (b // h_, 0, 0)),
             ],
             out_specs=out_specs,
@@ -219,7 +218,7 @@ def _flash_fwd_btd(qt, kt, vt, mask_bt, *, n_heads, scale, causal,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, nkb, block_k), lambda b, i, j: (b // h_, 0, 0)),
+            pl.BlockSpec((1, nk, block_k), lambda b, i, j: (b // h_, 0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shapes,

@@ -2,15 +2,11 @@
 (interpret mode on the CPU test backend; the kernel compiles natively on
 TPU — measured in PERF.md's "Pallas flash attention" section)."""
 
-import functools
-
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from deeplearning4j_tpu.ops.attention import dot_product_attention
 from deeplearning4j_tpu.ops import flash_attention as fa

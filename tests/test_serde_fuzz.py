@@ -1,0 +1,128 @@
+"""Serde fuzz: randomized layer stacks must survive JSON and YAML
+round-trips with bit-identical outputs.
+
+The config registry is the persistence story (checkpoints embed the JSON);
+hand-written serde tests only cover the layers someone thought to write a
+test for. This sweep builds random-but-valid MultiLayerConfigurations from
+the full registered layer set and asserts (a) round-trip configs re-build,
+(b) freshly-initialized outputs match exactly (same seed), (c) a train
+step matches too (updaters, schedules, regularization all serialized)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               DropoutLayer,
+                                               GlobalPoolingLayer,
+                                               LayerNormalization,
+                                               OutputLayer, RnnOutputLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.recurrent import (GravesBidirectionalLSTM,
+                                                  GravesLSTM)
+from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+_UPDATERS = ["sgd", "adam", "rmsprop", "nesterovs", "adagrad", "adadelta"]
+
+_FF_BODY = [
+    lambda r: DenseLayer(n_out=int(r.integers(4, 12)),
+                         activation=str(r.choice(["relu", "tanh",
+                                                  "sigmoid", "elu"]))),
+    lambda r: LayerNormalization(),
+    lambda r: BatchNormalization(),
+    lambda r: ActivationLayer(activation="tanh"),
+    lambda r: DropoutLayer(dropout=float(r.uniform(0.1, 0.5))),
+]
+
+_RNN_BODY = [
+    lambda r: GravesLSTM(n_out=2 * int(r.integers(2, 5)),
+                         activation="tanh"),
+    lambda r: GravesBidirectionalLSTM(n_out=2 * int(r.integers(2, 4)),
+                                      activation="tanh"),
+    lambda r: SelfAttentionLayer(n_heads=2),
+    lambda r: LayerNormalization(),
+]
+
+
+def _rand_ff_conf(r):
+    b = (NeuralNetConfiguration.builder()
+         .seed(int(r.integers(0, 1000)))
+         .updater(str(r.choice(_UPDATERS)))
+         .learning_rate(float(r.uniform(1e-3, 1e-1)))
+         .list())
+    for _ in range(int(r.integers(1, 4))):
+        b = b.layer(r.choice(_FF_BODY)(r))
+    return (b.layer(OutputLayer(n_out=3, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+
+
+def _rand_rnn_conf(r):
+    b = (NeuralNetConfiguration.builder()
+         .seed(int(r.integers(0, 1000)))
+         .updater(str(r.choice(_UPDATERS)))
+         .learning_rate(float(r.uniform(1e-3, 1e-1)))
+         .list())
+    for _ in range(int(r.integers(1, 3))):
+        b = b.layer(r.choice(_RNN_BODY)(r))
+    return (b.layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+            .set_input_type(InputType.recurrent(6)).build())
+
+
+def _rand_cnn_conf(r):
+    b = (NeuralNetConfiguration.builder()
+         .seed(int(r.integers(0, 1000)))
+         .updater(str(r.choice(_UPDATERS)))
+         .learning_rate(float(r.uniform(1e-3, 1e-1)))
+         .list()
+         .layer(ConvolutionLayer(n_out=int(r.integers(2, 6)),
+                                 kernel_size=(3, 3), activation="relu")))
+    if r.random() < 0.5:
+        b = b.layer(BatchNormalization())
+    if r.random() < 0.5:
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+    if r.random() < 0.3:
+        b = b.layer(GlobalPoolingLayer(pooling_type="max"))
+    return (b.layer(OutputLayer(n_out=3, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 2)).build())
+
+
+def _x_for(conf, r):
+    it = conf.input_type
+    if it.kind == "recurrent":
+        return r.normal(size=(4, 5, it.size)).astype(np.float32)
+    if it.kind == "convolutional":
+        return r.normal(size=(4, it.height, it.width,
+                              it.channels)).astype(np.float32)
+    return r.normal(size=(4, it.flat_size())).astype(np.float32)
+
+
+@pytest.mark.parametrize("family,seed", [
+    (fam, s) for fam in ("ff", "rnn", "cnn") for s in range(4)])
+def test_random_config_roundtrip(family, seed):
+    r = np.random.default_rng(seed * 31 + {"ff": 0, "rnn": 1, "cnn": 2}[family])
+    conf = {"ff": _rand_ff_conf, "rnn": _rand_rnn_conf,
+            "cnn": _rand_cnn_conf}[family](r)
+    for codec in ("json", "yaml"):
+        if codec == "json":
+            conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        else:
+            conf2 = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+        x = _x_for(conf, r)
+        y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 4)]
+        if conf.layers[-1].__class__.__name__ == "RnnOutputLayer":
+            y = np.eye(3, dtype=np.float32)[r.integers(0, 3, (4, 5))]
+        a, b = MultiLayerNetwork(conf).init(), MultiLayerNetwork(conf2).init()
+        np.testing.assert_array_equal(np.asarray(a.output(x)),
+                                      np.asarray(b.output(x)))
+        la, lb = float(a.fit_batch(x, y)), float(b.fit_batch(x, y))
+        assert la == lb, (codec, la, lb)
+        np.testing.assert_array_equal(np.asarray(a.output(x)),
+                                      np.asarray(b.output(x)))
