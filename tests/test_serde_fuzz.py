@@ -126,3 +126,55 @@ def test_random_config_roundtrip(family, seed):
         assert la == lb, (codec, la, lb)
         np.testing.assert_array_equal(np.asarray(a.output(x)),
                                       np.asarray(b.output(x)))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_graph_roundtrip(seed):
+    """Random DAGs (branch + merge/elementwise/scale/subset vertices) must
+    survive JSON and YAML round-trips with bit-identical outputs."""
+    from deeplearning4j_tpu.nn.conf.graph import (
+        ComputationGraphConfiguration, ElementWiseVertex, MergeVertex,
+        ScaleVertex, SubsetVertex)
+    from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+    r = np.random.default_rng(100 + seed)
+    b = (NeuralNetConfiguration.builder()
+         .seed(int(r.integers(0, 1000)))
+         .updater(str(r.choice(_UPDATERS)))
+         .learning_rate(float(r.uniform(1e-3, 1e-1)))
+         .graph_builder().add_inputs("in"))
+    width = int(r.integers(4, 9))
+    b.add_layer("d1", DenseLayer(n_out=width, activation="tanh"), "in")
+    b.add_layer("d2", DenseLayer(n_out=width, activation="relu"), "d1")
+    merge_kind = r.choice(["elementwise", "merge", "scale_subset"])
+    if merge_kind == "elementwise":
+        b.add_vertex("joined", ElementWiseVertex(
+            op=str(r.choice(["add", "max", "average"]))), "d1", "d2")
+        head_in = "joined"
+    elif merge_kind == "merge":
+        b.add_vertex("joined", MergeVertex(), "d1", "d2")
+        head_in = "joined"
+    else:
+        b.add_vertex("scaled", ScaleVertex(scale=float(r.uniform(0.5, 2.0))),
+                     "d2")
+        b.add_vertex("joined", SubsetVertex(from_idx=0, to_idx=width - 1),
+                     "scaled")
+        head_in = "joined"
+    b.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"), head_in)
+    b.set_outputs("out").set_input_types(InputType.feed_forward(5))
+    conf = b.build()
+
+    x = r.normal(size=(4, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 4)]
+    for codec in ("json", "yaml"):
+        conf2 = (ComputationGraphConfiguration.from_json(conf.to_json())
+                 if codec == "json"
+                 else ComputationGraphConfiguration.from_yaml(conf.to_yaml()))
+        a = ComputationGraph(conf).init()
+        c = ComputationGraph(conf2).init()
+        np.testing.assert_array_equal(np.asarray(a.output([x])),
+                                      np.asarray(c.output([x])))
+        la = float(a.fit_batch([x], [y]))
+        lc = float(c.fit_batch([x], [y]))
+        assert la == lc, (codec, la, lc)
