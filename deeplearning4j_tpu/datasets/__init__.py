@@ -18,6 +18,7 @@ from .dataset import DataSet, MultiDataSet
 from .iterator import (
     ArrayDataSetIterator,
     AsyncDataSetIterator,
+    AsyncMultiDataSetIterator,
     DataSetIterator,
     ExistingDataSetIterator,
     ListDataSetIterator,
@@ -41,6 +42,7 @@ __all__ = [
     "MultipleEpochsIterator",
     "SamplingDataSetIterator",
     "AsyncDataSetIterator",
+    "AsyncMultiDataSetIterator",
     "MnistDataSetIterator",
     "IrisDataSetIterator",
     "CifarDataSetIterator",

@@ -228,18 +228,21 @@ class AsyncDataSetIterator(DataSetIterator):
         self._peek = None
         self._start()
 
+    def _stage(self, ds):
+        import jax
+        return DataSet(
+            jax.device_put(ds.features, self.device),
+            jax.device_put(ds.labels, self.device),
+            None if ds.features_mask is None
+            else jax.device_put(ds.features_mask, self.device),
+            None if ds.labels_mask is None
+            else jax.device_put(ds.labels_mask, self.device))
+
     def _producer(self) -> None:
         try:
             for ds in self.base:
                 if self.device_put:
-                    import jax
-                    ds = DataSet(
-                        jax.device_put(ds.features, self.device),
-                        jax.device_put(ds.labels, self.device),
-                        None if ds.features_mask is None
-                        else jax.device_put(ds.features_mask, self.device),
-                        None if ds.labels_mask is None
-                        else jax.device_put(ds.labels_mask, self.device))
+                    ds = self._stage(ds)
                 self._queue.put(ds)
         except BaseException as e:  # surfaced on the consumer side
             self._error = e
@@ -278,3 +281,18 @@ class AsyncDataSetIterator(DataSetIterator):
         self.base.reset()
         self._queue = queue.Queue(maxsize=self.queue_size)
         self._start()
+
+
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Background prefetch for MultiDataSet iterators — the multi-input/
+    multi-output ComputationGraph feed (parity:
+    ``AsyncMultiDataSetIterator.java``). Same producer/queue machinery as
+    :class:`AsyncDataSetIterator`; only the device staging differs."""
+
+    def _stage(self, mds):
+        import jax
+        from .dataset import MultiDataSet
+        put = lambda xs: (None if xs is None
+                          else [jax.device_put(x, self.device) for x in xs])
+        return MultiDataSet(put(mds.features), put(mds.labels),
+                            put(mds.features_masks), put(mds.labels_masks))
