@@ -24,7 +24,7 @@ def test_example_smoke(script):
                PYTHONPATH=_REPO)
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", script), "--smoke"],
-        capture_output=True, text=True, env=env, timeout=600,
+        capture_output=True, text=True, env=env, timeout=900,
         cwd=_REPO)
     assert proc.returncode == 0, (
         f"{script} failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
