@@ -10,11 +10,18 @@ from typing import Iterator, Optional, Tuple
 def iter_batches(data, labels=None, mask=None) -> Iterator[Tuple]:
     """Yield (features, labels, features_mask) triples.
 
-    `data` may be: (features, labels[, mask]) arrays; a DataSet (has
-    .features/.labels); or an iterator yielding DataSets or tuples.
+    `data` may be: (features, labels[, mask]) arrays; a bare feature
+    array with no labels (ONE unlabeled batch, labels None — the
+    pretrain() call pattern); a DataSet (has .features/.labels); or an
+    iterator yielding DataSets or tuples.
     """
     if labels is not None:
         yield (data, labels, mask)
+        return
+    if hasattr(data, "shape"):
+        # bare feature array, no labels: ONE unlabeled batch (the
+        # pretrain() call pattern) — iterating its rows is never meant
+        yield (data, None, mask)
         return
     if hasattr(data, "features"):
         yield (data.features, data.labels,
