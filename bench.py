@@ -139,8 +139,10 @@ def bench_lenet() -> dict:
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     # bs1024: small-model MFU is dispatch/HBM-bound and scales with
-    # batch (512: 3.2%, 1024: 6.9%, 2048: 8.3% measured)
-    batch, k, rounds = 1024, 32, 4
+    # batch (512: 3.2%, 1024: 6.9%, 2048: 8.3% measured); k=256 amortizes
+    # per-update overhead further (k=32: 0.8-1.0M, k=256: 1.68M ex/s;
+    # bf16 measured SLOWER here — layout conversions dominate tiny convs)
+    batch, k, rounds = 1024, 256, 4
     net = MultiLayerNetwork(lenet()).init()
     xs, ys = _stage_batches(1, batch, (784,), 10, seed=7)
     x, y = jax.device_put(xs[0]), jax.device_put(ys[0])
