@@ -575,6 +575,7 @@ class ComputationGraph:
         arrays or lists of such (multi-input/multi-output); returns [k] losses."""
         xs = [jnp.asarray(a) for a in _as_list(xs)]
         ys = [jnp.asarray(a) for a in _as_list(ys)]
+        self._reject_tbptt([x[0] for x in xs], "fit_scan")
         k = xs[0].shape[0]
         if masks is not None:
             masks = [None if m is None else jnp.asarray(m)
@@ -648,6 +649,7 @@ class ComputationGraph:
         steady-state throughput measurement; returns [k] losses."""
         inputs = [jnp.asarray(x) for x in _as_list(inputs)]
         labels = [jnp.asarray(y) for y in _as_list(labels)]
+        self._reject_tbptt(inputs, "fit_repeated")
         if masks is not None:
             masks = [None if m is None else jnp.asarray(m)
                      for m in _as_list(masks)]
@@ -674,25 +676,107 @@ class ComputationGraph:
         return losses
 
     def fit_batch(self, inputs, labels, masks=None):
-        """One update. inputs/labels: array or list of arrays (multi-input /
-        multi-output); masks: optional list of feature masks."""
+        """One update (tbptt-aware). inputs/labels: array or list of arrays
+        (multi-input / multi-output); masks: optional list of feature
+        masks."""
         inputs = [jnp.asarray(x) for x in _as_list(inputs)]
         labels = [jnp.asarray(y) for y in _as_list(labels)]
         if masks is not None:
             masks = [None if m is None else jnp.asarray(m)
                      for m in _as_list(masks)]
+        T = self._tbptt_T(inputs)
+        if T is not None and T > self.conf.tbptt_fwd_length:
+            return self._fit_tbptt(inputs, labels, masks, T)
+        loss = self._step_and_update(inputs, labels, masks, None)
+        self._score = loss
+        self._fire_iteration(inputs[0].shape[0], loss)
+        return loss
+
+    def _reject_tbptt(self, inputs, api: str) -> None:
+        """The fused-scan paths run ONE full-sequence BPTT update per batch;
+        silently doing that under a truncated_bptt config would change both
+        memory behavior and optimization semantics — refuse loudly."""
+        T = self._tbptt_T(inputs)
+        if T is not None and T > self.conf.tbptt_fwd_length:
+            raise ValueError(
+                f"{api} does not chunk truncated BPTT (T={T} > "
+                f"tbptt_fwd_length={self.conf.tbptt_fwd_length}); use "
+                "fit()/fit_batch(), or pre-chunk the sequences")
+
+    def _tbptt_T(self, inputs):
+        """The time-series length for truncated BPTT, scanning ALL inputs
+        (the first may be a static [b, f] feature — reference CG scans the
+        whole input set). None when tbptt is off or nothing is temporal;
+        mixed 3-D lengths are ambiguous and raise."""
+        if self.conf.backprop_type != "truncated_bptt":
+            return None
+        ts = {int(x.shape[1]) for x in inputs if x.ndim == 3}
+        if not ts:
+            return None
+        if len(ts) > 1:
+            raise ValueError(
+                f"truncated_bptt with differing sequence lengths {sorted(ts)} "
+                "across inputs is ambiguous — align or pad them")
+        return ts.pop()
+
+    def _fit_tbptt(self, inputs, labels, masks, T):
+        """Truncated BPTT over the DAG: slice [b, t, ...] into fwd-length
+        chunks, carrying every recurrent vertex's h/c across chunks with
+        gradients stopped at the boundary (parity: the reference
+        ComputationGraph's doTruncatedBPTT)."""
+        length = self.conf.tbptt_fwd_length
+        batch = inputs[0].shape[0]
+
+        def _slice(a, start, end):
+            return (a[:, start:end]
+                    if a is not None and a.ndim == 3 and a.shape[1] == T
+                    else a)
+
+        rnn_state = self._zero_rnn_carry(batch)
+        loss = 0.0
+        for start in range(0, T, length):
+            end = min(start + length, T)
+            xs = [_slice(x, start, end) for x in inputs]
+            ys = [_slice(y, start, end) for y in labels]
+            ms = (None if masks is None else
+                  [m[:, start:end] if (m is not None and m.ndim >= 2
+                                       and m.shape[1] == T) else m
+                   for m in masks])
+            loss = self._step_and_update(xs, ys, ms, rnn_state)
+            rnn_state = self._last_rnn_carry
+        self._score = loss
+        self._fire_iteration(batch, loss)
+        return loss
+
+    def _zero_rnn_carry(self, batch):
+        mbs = self._minibatch_map(batch)
+        carry = {}
+        for name in self.topo_order:
+            layer = self._vertex_layer(name)
+            if layer is not None and hasattr(layer, "_zero_state"):
+                mb = mbs[self.conf.vertex_inputs[name][0]]
+                h, c = layer._zero_state(mb, self.policy)
+                carry[name] = {"h": h, "c": c}
+            else:
+                carry[name] = {}
+        return carry
+
+    def _step_and_update(self, inputs, labels, masks, rnn_state):
         rng = _rng.fold_name(_rng.key(self.training.seed),
                              f"update_{self._update_count}")
         it = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, loss = self._train_step()(
-            self.params, self.updater_state, self._states_map(), inputs,
-            labels, masks, rng, it)
+            self.params, self.updater_state, self._states_map(rnn_state),
+            inputs, labels, masks, rng, it)
         self.params = params
         self.updater_state = opt_state
         self._update_count += 1
+        # stop-gradient boundary for tbptt: carry values, not graph
+        self._last_rnn_carry = jax.tree_util.tree_map(
+            jax.lax.stop_gradient,
+            {name: {k: v for k, v in st.items() if k in ("h", "c")}
+             for name, st in new_states.items()})
         self._persist_states(new_states)
-        self._score = loss
-        self._fire_iteration(inputs[0].shape[0], loss)
         return loss
 
     def fit(self, data, labels=None, *, epochs: int = 1) -> None:

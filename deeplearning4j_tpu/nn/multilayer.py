@@ -442,6 +442,7 @@ class MultiLayerNetwork:
         Returns the per-step losses (device array, shape [k]).
         """
         xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+        self._reject_tbptt(xs[0], "fit_scan")
         k = xs.shape[0]
         if masks is not None:
             masks = jnp.asarray(masks)
@@ -511,6 +512,7 @@ class MultiLayerNetwork:
         counters, and listener firing — but one dispatch and one batch of HBM.
         Used for steady-state throughput measurement; returns [k] losses."""
         x, y = jnp.asarray(x), jnp.asarray(y)
+        self._reject_tbptt(x, "fit_repeated")
         if mask is not None:
             mask = jnp.asarray(mask)
         fn = self._jit_cache.get("train_repeat")
@@ -591,6 +593,17 @@ class MultiLayerNetwork:
         loss = self._step_and_update(x, y, mask, rnn_state=None)
         self._fire_iteration(x.shape[0], loss)
         return loss
+
+    def _reject_tbptt(self, x, api: str) -> None:
+        """The fused-scan paths run ONE full-sequence BPTT update per batch;
+        silently doing that under a truncated_bptt config would change both
+        memory behavior and optimization semantics — refuse loudly."""
+        if (self.conf.backprop_type == "truncated_bptt" and x.ndim == 3
+                and x.shape[1] > self.conf.tbptt_fwd_length):
+            raise ValueError(
+                f"{api} does not chunk truncated BPTT (T={x.shape[1]} > "
+                f"tbptt_fwd_length={self.conf.tbptt_fwd_length}); use "
+                "fit()/fit_batch(), or pre-chunk the sequences")
 
     def _fit_tbptt(self, x, y, mask) -> float:
         """Truncated BPTT: slice [b,t,..] into fwd-length chunks, carrying

@@ -173,3 +173,22 @@ class TestFitRepeated:
         for a, b in zip(jax.tree_util.tree_leaves(ref.state),
                         jax.tree_util.tree_leaves(net.state)):
             assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_scan_paths_reject_tbptt(rng):
+    """fit_scan/fit_repeated run full-sequence BPTT; a truncated_bptt
+    config with longer sequences must be refused, not silently changed."""
+    import pytest
+    from deeplearning4j_tpu.models import char_rnn_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = char_rnn_lstm(12, hidden=8, layers=1, tbptt_length=4)
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(2, 10, 12)).astype(np.float32)
+    y = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (2, 10))]
+    with pytest.raises(ValueError, match="truncated BPTT"):
+        net.fit_repeated(x, y, 4)
+    with pytest.raises(ValueError, match="truncated BPTT"):
+        net.fit_scan(x[None], y[None])
+    # sequences at/below the fwd length stay on the fast path
+    losses = net.fit_repeated(x[:, :4], y[:, :4], 2)
+    assert np.all(np.isfinite(np.asarray(losses)))

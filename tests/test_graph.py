@@ -4,6 +4,7 @@ Parity model: reference ComputationGraphConfigurationTest, TestComputationGraphN
 GradientCheckTestsComputationGraph.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -473,3 +474,76 @@ class TestBatchAxisMinibatchTracking:
                                   .astype(np.float32)])
         assert np.allclose(np.asarray(acts["out"])[:b],
                            np.asarray(acts2["out"])[:b], atol=1e-6)
+
+
+class TestGraphTbptt:
+    """ComputationGraph truncated BPTT (parity: the reference CG's
+    doTruncatedBPTT — chunked updates with carried recurrent state)."""
+
+    def _conf(self, tbptt):
+        from deeplearning4j_tpu.nn.conf.recurrent import GravesLSTM
+        from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+        b = (_base(lr=5e-2).graph_builder().add_inputs("in")
+             .add_layer("lstm", GravesLSTM(n_in=5, n_out=8,
+                                           activation="tanh"), "in")
+             .add_layer("out", RnnOutputLayer(n_in=8, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "lstm")
+             .set_outputs("out"))
+        conf = b.build()
+        if tbptt:
+            conf.backprop_type = "truncated_bptt"
+            conf.tbptt_fwd_length = 4
+        return conf
+
+    def test_tbptt_chunks_and_trains(self, rng):
+        x = rng.normal(size=(4, 10, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 10))]
+        net = ComputationGraph(self._conf(True)).init()
+        losses = [float(net.fit_batch([x], [y])) for _ in range(25)]
+        # 10 timesteps / fwd-length 4 -> 3 parameter updates per batch
+        assert net._update_count == 25 * 3
+        assert net.iteration_count == 25
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    def test_tbptt_carries_state_across_chunks(self, rng):
+        """The first chunk's final h/c must seed the second chunk. With the
+        learning rate frozen at 0, fit_batch returns the SECOND chunk's
+        loss computed from the carried state — which must equal the loss of
+        steps [4:8] seeded with the state after running steps [0:4]. A
+        zeroed carry fails this."""
+        import jax
+        conf = self._conf(True)
+        conf.training.learning_rate = 0.0
+        x = rng.normal(size=(2, 8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 8))]
+        net = ComputationGraph(conf).init()
+        l_chunk2 = float(net.fit_batch([x], [y]))
+        assert net._update_count == 2
+
+        # reference: run steps [0:4] to get the carry, then score [4:8]
+        ref = ComputationGraph(conf).init()
+        states = ref._states_map(ref._zero_rnn_carry(2))
+        _, mid_states = ref._forward(ref.params, states,
+                                     [jnp.asarray(x[:, :4])], train=True)
+        carry = {name: {k: v for k, v in st.items() if k in ("h", "c")}
+                 for name, st in mid_states.items()}
+        l_ref, _ = ref._loss_fn(ref.params, ref._states_map(carry),
+                                [jnp.asarray(x[:, 4:])],
+                                [jnp.asarray(y[:, 4:])], None, None)
+        assert l_chunk2 == pytest.approx(float(l_ref), rel=1e-5)
+        # and a ZEROED carry gives a different loss (the invariant bites)
+        l_zero, _ = ref._loss_fn(ref.params,
+                                 ref._states_map(ref._zero_rnn_carry(2)),
+                                 [jnp.asarray(x[:, 4:])],
+                                 [jnp.asarray(y[:, 4:])], None, None)
+        assert abs(float(l_zero) - l_chunk2) > 1e-4
+
+    def test_scan_paths_reject_tbptt(self, rng):
+        x = rng.normal(size=(4, 10, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 10))]
+        net = ComputationGraph(self._conf(True)).init()
+        with pytest.raises(ValueError, match="truncated BPTT"):
+            net.fit_repeated([x], [y], 4)
+        with pytest.raises(ValueError, match="truncated BPTT"):
+            net.fit_scan([x[None]], [y[None]])
