@@ -26,6 +26,7 @@ from .listeners import (
     ScoreIterationListener,
     PerformanceListener,
     CollectScoresIterationListener,
+    ParamAndGradientIterationListener,
     ComposableIterationListener,
 )
 
@@ -33,5 +34,6 @@ __all__ = [
     "Updater", "make_updater", "learning_rate_at", "normalize_gradients",
     "apply_updates", "TrainingListener", "ScoreIterationListener",
     "PerformanceListener", "CollectScoresIterationListener",
+    "ParamAndGradientIterationListener",
     "ComposableIterationListener",
 ]

@@ -149,3 +149,69 @@ class ComposableIterationListener(TrainingListener):
     def on_backward_pass(self, model):
         for l in self.listeners:
             l.on_backward_pass(model)
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Log per-layer parameter and update magnitudes every N iterations
+    (parity: ``ParamAndGradientIterationListener.java``).
+
+    The reference prints parameter and raw-gradient statistics; here the
+    UPDATE magnitude (parameter delta across the iteration) stands in for
+    the gradient, which never leaves the fused jitted train step. Columns:
+    mean |param|, mean |update|, and their ratio — the classic
+    learning-rate sanity signal (~1e-3 is healthy).
+
+    Use with ``fit``/``fit_batch``. The fused-scan paths
+    (``fit_scan``/``fit_repeated``) replay listener fires AFTER all K
+    updates landed, so deltas there are 0 — the listener prints a hint
+    instead of a misleading zero signal.
+    """
+
+    def __init__(self, print_iterations: int = 10,
+                 log_fn: Optional[Callable[[str], None]] = None):
+        self.print_iterations = max(1, int(print_iterations))
+        self._log = log_fn or logger.info
+        self._prev = None
+
+    @staticmethod
+    def _flat(model):
+        import jax
+        import numpy as np
+        return {jax.tree_util.keystr(path):
+                np.asarray(leaf, dtype=np.float32)
+                for path, leaf in
+                jax.tree_util.tree_flatten_with_path(model.params)[0]}
+
+    def iteration_done(self, model, iteration, score) -> None:
+        import numpy as np
+
+        prints = iteration % self.print_iterations == 0
+        snapshots = (iteration + 1) % self.print_iterations == 0
+        if not (prints or snapshots):
+            return
+        flat = self._flat(model)
+        if prints:
+            prev = self._prev or {}
+            lines = []
+            deltas = []
+            for name, a in flat.items():
+                p_mag = float(np.mean(np.abs(a)))
+                if name in prev:
+                    u_mag = float(np.mean(np.abs(a - prev[name])))
+                    deltas.append(u_mag)
+                    ratio = u_mag / (p_mag + 1e-12)
+                    lines.append(f"  {name}: |p|={p_mag:.3e} "
+                                 f"|Δp|={u_mag:.3e} ratio={ratio:.2e}")
+                else:
+                    lines.append(f"  {name}: |p|={p_mag:.3e}")
+            if deltas and max(deltas) == 0.0:
+                lines.append(
+                    "  (all deltas are exactly 0 — fused-scan replay? "
+                    "fit_scan/fit_repeated apply updates before listeners "
+                    "fire; use fit/fit_batch with this listener)")
+            self._log(f"iteration {iteration} param/update stats:\n"
+                      + "\n".join(lines))
+        if snapshots:
+            # the iteration right before the next print: its delta to the
+            # printed params is ONE update's magnitude
+            self._prev = flat

@@ -223,3 +223,24 @@ class TestPerLayerOverrides:
         net.fit_batch(x, y)
         assert np.allclose(w0, np.asarray(net.params["layer_0"]["W"]))  # frozen
         assert not np.allclose(out_w0, np.asarray(net.params["layer_1"]["W"]))
+
+
+class TestParamAndGradientListener:
+    def test_logs_param_and_update_magnitudes(self, rng):
+        from deeplearning4j_tpu.optimize.listeners import (
+            ParamAndGradientIterationListener)
+        conf = _dense_conf()
+        net = MultiLayerNetwork(conf).init()
+        logs = []
+        net.add_listener(ParamAndGradientIterationListener(
+            print_iterations=3, log_fn=logs.append))
+        x = rng.normal(size=(8, 10)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        for _ in range(7):
+            net.fit_batch(x, y)
+        # iterations are 1-based at the listener: prints at 3 and 6, each
+        # with a one-update delta snapshotted the iteration before
+        assert len(logs) == 2
+        for entry in logs:
+            assert "|p|=" in entry and "|Δp|=" in entry and "ratio=" in entry
+        assert "layer_0" in logs[0] and "W" in logs[0]
