@@ -22,7 +22,7 @@ interpret + on-chip parity tests).
 
 Measured numbers live in PERF.md ("Pallas flash attention" section —
 the single source of truth): forward 1.8-2.8× over the XLA fused path at
-t≥4096, backward 1.6×-parity, and t=16384 runs fwd+bwd where XLA OOMs.
+t≥4096, backward 1.8×-1.1×, and t=16384 runs fwd+bwd where XLA OOMs.
 
 Routing (``ops.attention.dot_product_attention``): auto at t ≥ 4096 on
 the TPU backend; ``DL4JTPU_FLASH_ATTENTION=1`` forces it on (any length),
@@ -351,12 +351,19 @@ def _core_bwd_rule(causal, scale, block_q, interpret, res, g):
     s = _resolve_scale(scale, d)
     to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     mk = jnp.repeat(mask.astype(jnp.float32), h, axis=0)
-    # backward tiles are independent of the forward block size; 512-wide
-    # tiles keep the MXU busy (128-row tiles measured ~1.5× slower)
-    bq_bwd = 512 if t % 512 == 0 else block_q
+    # backward tiles are independent of the forward block size; wider
+    # tiles keep the MXU busy (measured per-step at bf16 t=8192 / f32
+    # t=4096: 128-tiles ~1.5x slower than 512, 1024x1024 another ~20%
+    # faster than 512x512 and within 5% of the plateau at both sizes)
+    if t % 1024 == 0:
+        bq_bwd = bk_bwd = 1024
+    elif t % 512 == 0:
+        bq_bwd = bk_bwd = 512
+    else:
+        bq_bwd, bk_bwd = block_q, block_q
     dq, dk, dv = _flash_bwd_btd(
         to_btd(q), to_btd(k), to_btd(v), mk, to_btd(out), lse, to_btd(g),
-        scale=s, causal=causal, block_q=bq_bwd, block_k=512)
+        scale=s, causal=causal, block_q=bq_bwd, block_k=bk_bwd)
     back = lambda a: a.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     return back(dq), back(dk), back(dv), jnp.zeros_like(mask,
                                                         dtype=jnp.float32)
