@@ -169,6 +169,13 @@ def _flash_fwd_btd(qt, kt, vt, mask_bt, *, n_heads, scale, causal,
             f"flash_attention needs t % block_q == 0 (t={t}, "
             f"block_q={block_q}) — unwritten tail blocks would return "
             "uninitialized memory; use the XLA path for ragged lengths")
+    # routing granularity is the caller's block_q; the KERNEL tile can be
+    # wider when t allows (512-row q tiles measured ~10% faster at both
+    # f32-4096 and bf16-8192)
+    for wider in (512, 256):
+        if wider > block_q and t % wider == 0:
+            block_q = wider
+            break
     if t % block_k:
         block_k = block_q
     nk = t // block_k
