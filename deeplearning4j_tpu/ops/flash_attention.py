@@ -159,7 +159,8 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, mk_ref, o_ref, lse_ref, m_s,
 
 
 def _flash_fwd_btd(qt, kt, vt, mask_bt, *, n_heads, scale, causal,
-                   block_q, interpret, block_k: int = 512):
+                   block_q, interpret, block_k: int = 512,
+                   auto_tile: bool = False):
     """[bh, t, d] q/k/v + [b, t] key mask → ([bh, t, d] out, [bh, t] lse).
     The mask is NOT head-folded: index maps read row ``bh // n_heads``, so
     one [b, ...] mask array serves every head."""
@@ -169,13 +170,16 @@ def _flash_fwd_btd(qt, kt, vt, mask_bt, *, n_heads, scale, causal,
             f"flash_attention needs t % block_q == 0 (t={t}, "
             f"block_q={block_q}) — unwritten tail blocks would return "
             "uninitialized memory; use the XLA path for ragged lengths")
-    # routing granularity is the caller's block_q; the KERNEL tile can be
-    # wider when t allows (512-row q tiles measured ~10% faster at both
-    # f32-4096 and bf16-8192)
-    for wider in (512, 256):
-        if wider > block_q and t % wider == 0:
-            block_q = wider
-            break
+    if auto_tile:
+        # default-tile callers get wider q tiles when t allows (512 rows
+        # measured ~10% faster at f32-4096 and bf16-8192, d=128); an
+        # EXPLICIT block_q is never overridden, and the upgrade is skipped
+        # when the q/num tile would exceed ~512KB VMEM (large head dims)
+        for wider in (512, 256):
+            if (wider > block_q and t % wider == 0
+                    and wider * d * 4 <= 512 * 1024):
+                block_q = wider
+                break
     if t % block_k:
         block_k = block_q
     nk = t // block_k
@@ -343,7 +347,8 @@ def _core_fwd(q, k, v, mask, causal, scale, block_q, interpret):
     to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     out, lse = _flash_fwd_btd(to_btd(q), to_btd(k), to_btd(v), mask,
                               n_heads=h, scale=s, causal=causal,
-                              block_q=block_q, interpret=interpret)
+                              block_q=block_q or 128, interpret=interpret,
+                              auto_tile=block_q is None)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
 
 
@@ -367,7 +372,7 @@ def _core_bwd_rule(causal, scale, block_q, interpret, res, g):
     elif t % 512 == 0:
         bq_bwd = bk_bwd = 512
     else:
-        bq_bwd, bk_bwd = block_q, block_q
+        bq_bwd = bk_bwd = block_q or 128
     dq, dk, dv = _flash_bwd_btd(
         to_btd(q), to_btd(k), to_btd(v), mk, to_btd(out), lse, to_btd(g),
         scale=s, causal=causal, block_q=bq_bwd, block_k=bk_bwd)
@@ -379,10 +384,12 @@ def _core_bwd_rule(causal, scale, block_q, interpret, res, g):
 _flash_core.defvjp(_core_fwd_rule, _core_bwd_rule)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     interpret=False, mask=None):
     """[b, t, h, d] attention with the Pallas forward and blockwise
-    backward. t must divide by ``block_q``. ``mask``: optional [b, t_kv]
+    backward. t must divide by ``block_q`` (default: auto — 128-row
+    granularity, upgraded to wider tiles when t and the VMEM budget allow;
+    an explicit ``block_q`` is used as-is). ``mask``: optional [b, t_kv]
     key-validity mask (1=attend); rows with no attendable keys output 0."""
     if mask is None:
         mask = jnp.ones((q.shape[0], q.shape[1]), jnp.float32)
