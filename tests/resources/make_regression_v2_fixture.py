@@ -1,0 +1,54 @@
+"""Generate the v2 saved-model regression fixture (run from repo root).
+
+v1 locks the conv/pool/dense format; v2 locks the round-4 layer types —
+SelfAttentionLayer, LayerNormalization, GravesLSTM — plus adam updater
+state, so checkpoint compatibility for the attention stack is pinned the
+same way (see make_regression_fixture.py for the contract)."""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import LayerNormalization, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.recurrent import GravesLSTM
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util import save_model
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    conf = (NeuralNetConfiguration.builder().seed(99).updater("adam")
+            .learning_rate(5e-3).list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(LayerNormalization())
+            .layer(SelfAttentionLayer(n_heads=2, causal=True))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    r = np.random.RandomState(17)
+    x = r.randn(4, 7, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.randint(0, 3, (4, 7))]
+    for _ in range(3):
+        net.fit_batch(x, y)
+    save_model(net, os.path.join(here, "regression_v2.zip"),
+               save_updater=True)
+    np.savez(os.path.join(here, "regression_v2_expected.npz"),
+             x=x, y=y, out=np.asarray(net.output(x)),
+             score=np.float64(net.score_for(x, y)))
+    print("v2 fixture written")
+
+
+if __name__ == "__main__":
+    main()

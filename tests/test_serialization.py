@@ -120,29 +120,33 @@ class TestExtensionDtypes:
 
 
 class TestFormatRegression:
-    """Checked-in fixture from the format's first stable version must load
+    """Checked-in fixtures from the format's stable versions must load
     and predict identically forever (parity: reference
     ``regressiontest/RegressionTest050.java`` / ``RegressionTest060.java``
-    loading zips saved by older releases). If the serialization format
-    changes, it must stay backward-compatible — regenerating the fixture to
-    make this pass defeats its purpose."""
+    loading zips saved by older releases). v1 pins conv/pool/dense; v2
+    pins the attention stack (SelfAttentionLayer, LayerNormalization,
+    GravesLSTM) + adam state. If the serialization format changes, it must
+    stay backward-compatible — regenerating a fixture to make this pass
+    defeats its purpose."""
 
-    def test_v1_fixture_loads_and_predicts(self):
+    @pytest.fixture(params=["v1", "v2"])
+    def fixture(self, request):
         import os
         here = os.path.join(os.path.dirname(__file__), "resources")
-        exp = np.load(os.path.join(here, "regression_v1_expected.npz"))
-        net = load_model(os.path.join(here, "regression_v1.zip"))
+        exp = np.load(os.path.join(here, f"regression_{request.param}_expected.npz"))
+        net = load_model(os.path.join(here, f"regression_{request.param}.zip"))
+        return net, exp
+
+    def test_fixture_loads_and_predicts(self, fixture):
+        net, exp = fixture
         out = np.asarray(net.output(exp["x"]))
         np.testing.assert_allclose(out, exp["out"], rtol=1e-5, atol=1e-6)
         assert float(net.score_for(exp["x"], exp["y"])) == pytest.approx(
             float(exp["score"]), rel=1e-5)
 
-    def test_v1_fixture_resumes_training(self):
-        import os
-        here = os.path.join(os.path.dirname(__file__), "resources")
-        exp = np.load(os.path.join(here, "regression_v1_expected.npz"))
-        net = load_model(os.path.join(here, "regression_v1.zip"))
-        s0 = float(net.score_for(exp["x"], exp["y"]))
+    def test_fixture_resumes_training(self, fixture):
+        net, exp = fixture
+        s0 = float(exp["score"])
         for _ in range(3):
             net.fit_batch(exp["x"], exp["y"])
         assert float(net.score_for(exp["x"], exp["y"])) < s0
