@@ -369,11 +369,15 @@ def bench_flash_attention() -> dict:
         float(s)
         return (time.perf_counter() - t0) / iters * 1e3
 
+    prior_flag = os.environ.get("DL4JTPU_FLASH_ATTENTION")
     os.environ["DL4JTPU_FLASH_ATTENTION"] = "0"   # force f_xla's route
     try:
         ms_xla = _t(f_xla)
     finally:
-        os.environ.pop("DL4JTPU_FLASH_ATTENTION", None)
+        if prior_flag is None:
+            os.environ.pop("DL4JTPU_FLASH_ATTENTION", None)
+        else:
+            os.environ["DL4JTPU_FLASH_ATTENTION"] = prior_flag
     ms_flash = _t(f_flash)
     flops = 4.0 * b * h * t * t * d / 2  # causal
     return {"xla_ms": round(ms_xla, 2), "flash_ms": round(ms_flash, 2),

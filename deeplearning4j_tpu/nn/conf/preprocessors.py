@@ -239,7 +239,10 @@ class UnitVarianceProcessor(InputPreProcessor):
     eps: float = 1e-5
 
     def __call__(self, x, minibatch_size=None):
-        std = jnp.std(x, axis=0, keepdims=True, ddof=1) + self.eps
+        # ddof=1 is 0/0=NaN for a minibatch of 1; fall back to ddof=0 there
+        # (shape is static at trace time, so this is a compile-time branch).
+        std = jnp.std(x, axis=0, keepdims=True,
+                      ddof=1 if x.shape[0] > 1 else 0) + self.eps
         return x / jax.lax.stop_gradient(std)
 
     def output_type(self, input_type):
@@ -255,7 +258,8 @@ class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
 
     def __call__(self, x, minibatch_size=None):
         mean = x.mean(axis=0, keepdims=True)
-        std = jnp.std(x, axis=0, keepdims=True, ddof=1) + self.eps
+        std = jnp.std(x, axis=0, keepdims=True,
+                      ddof=1 if x.shape[0] > 1 else 0) + self.eps
         return (x - jax.lax.stop_gradient(mean)) / jax.lax.stop_gradient(std)
 
     def output_type(self, input_type):

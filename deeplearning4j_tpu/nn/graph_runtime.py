@@ -744,8 +744,11 @@ class ComputationGraph:
                    for m in masks])
             loss = self._step_and_update(xs, ys, ms, rnn_state)
             rnn_state = self._last_rnn_carry
-        self._score = loss
-        self._fire_iteration(batch, loss)
+            # one iteration (and listener firing) per TBPTT segment, matching
+            # the reference's doTruncatedBPTT accounting: listeners see every
+            # iteration number, not one per full-sequence batch.
+            self._score = loss
+            self._fire_iteration(batch, loss)
         return loss
 
     def _zero_rnn_carry(self, batch):
@@ -825,13 +828,21 @@ class ComputationGraph:
         for name in pre:
             step = make_pretrain_step(self._vertex_layer(name), lr,
                                       self.policy)
-            # upstream is frozen while this vertex trains: its input
-            # activations are constant across epochs — compute once
-            hiddens = [self._vertex_input_activation(
-                name, [jnp.asarray(np.asarray(x)) for x in _as_list(ins)])
-                for ins, _, _ in batches]
+            # upstream is frozen while this vertex trains, so its input
+            # activations are constant across epochs — but holding them all
+            # is O(dataset) device memory; only precompute when the reuse
+            # (epochs>1) and the footprint (few batches) justify it
+            cache_all = epochs > 1 and len(batches) <= 64
+
+            def _hid(ins):
+                return self._vertex_input_activation(
+                    name, [jnp.asarray(np.asarray(x)) for x in _as_list(ins)])
+
+            hiddens = ([_hid(ins) for ins, _, _ in batches]
+                       if cache_all else None)
             for e in range(epochs):
-                for bi, hidden in enumerate(hiddens):
+                for bi, (ins, _, _) in enumerate(batches):
+                    hidden = hiddens[bi] if cache_all else _hid(ins)
                     rng = _rng.fold_name(_rng.key(self.training.seed),
                                          f"pre_{name}_{e}_{bi}")
                     self.params[name] = step(self.params[name], hidden, rng)

@@ -684,12 +684,17 @@ class MultiLayerNetwork:
         batches = list(self._as_batches(data, labels, None))
         for i in pre_idx:
             step = make_pretrain_step(self.layers[i], lr, self.policy)
-            # earlier layers are frozen while layer i trains: its input
-            # activations are constant across epochs — compute once
-            hiddens = [self._activation_upto(jnp.asarray(x), i)
-                       for x, _, _ in batches]
+            # earlier layers are frozen while layer i trains, so its input
+            # activations are constant across epochs — but materializing all
+            # of them is O(dataset) device memory, so only precompute when
+            # the reuse (epochs>1) and the footprint (few batches) justify it
+            cache_all = epochs > 1 and len(batches) <= 64
+            hiddens = ([self._activation_upto(jnp.asarray(x), i)
+                        for x, _, _ in batches] if cache_all else None)
             for e in range(epochs):
-                for bi, hidden in enumerate(hiddens):
+                for bi, (x, _, _) in enumerate(batches):
+                    hidden = (hiddens[bi] if cache_all
+                              else self._activation_upto(jnp.asarray(x), i))
                     rng = _rng.fold_name(
                         _rng.key(self.training.seed), f"pre_{i}_{e}_{bi}")
                     self.params[_layer_key(i)] = step(

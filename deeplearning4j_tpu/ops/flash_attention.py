@@ -403,7 +403,12 @@ def flash_available(q_shape, mask, block_q: int = 128) -> bool:
     ``DL4JTPU_FLASH_ATTENTION``: ``1`` forces it on, ``0`` off; unset =
     auto — on for t ≥ 4096 on the TPU backend (where it measures ≥2× over
     the XLA path on v5e; below that XLA's fusion already sits at the
-    memory floor). Non-multiple-of-block lengths always use the XLA path."""
+    memory floor). Non-multiple-of-block lengths always use the XLA path.
+
+    NOTE: this runs at *trace* time. The chosen route is baked into any
+    already-compiled jit — set the flag before the first trace of a step
+    function (or clear jit caches via ``fn.clear_cache()`` /
+    ``jax.clear_caches()``) for a toggle to take effect."""
     import os
     flag = os.environ.get("DL4JTPU_FLASH_ATTENTION", "auto")
     if flag == "0" or q_shape[1] % block_q:

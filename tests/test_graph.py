@@ -501,9 +501,11 @@ class TestGraphTbptt:
         y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 10))]
         net = ComputationGraph(self._conf(True)).init()
         losses = [float(net.fit_batch([x], [y])) for _ in range(25)]
-        # 10 timesteps / fwd-length 4 -> 3 parameter updates per batch
+        # 10 timesteps / fwd-length 4 -> 3 parameter updates per batch, and
+        # one iteration/listener firing per TBPTT segment (reference
+        # doTruncatedBPTT accounting): iteration_count tracks _update_count
         assert net._update_count == 25 * 3
-        assert net.iteration_count == 25
+        assert net.iteration_count == 25 * 3
         assert losses[-1] < losses[0], (losses[0], losses[-1])
 
     def test_tbptt_carries_state_across_chunks(self, rng):
