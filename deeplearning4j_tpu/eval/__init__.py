@@ -13,13 +13,14 @@ INDArray bookkeeping with numpy.
 """
 
 from .confusion import ConfusionMatrix
-from .evaluation import Evaluation
+from .evaluation import Evaluation, Prediction
 from .regression import RegressionEvaluation
 from .roc import ROC, ROCMultiClass
 
 __all__ = [
     "ConfusionMatrix",
     "Evaluation",
+    "Prediction",
     "RegressionEvaluation",
     "ROC",
     "ROCMultiClass",

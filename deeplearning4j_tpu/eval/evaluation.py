@@ -8,11 +8,30 @@ accuracy / per-class precision / recall / F1 plus macro averages, matching
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .confusion import ConfusionMatrix
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    """One example's (actual, predicted, provenance) triple (parity:
+    reference ``eval/meta/Prediction.java``). ``record_metadata`` is
+    whatever the iterator collected — normally a
+    ``datavec.readers.RecordMetaData`` — so a misclassified example can be
+    traced back to its source record and reloaded via
+    ``RecordReaderDataSetIterator.load_from_metadata``."""
+
+    actual_class: int
+    predicted_class: int
+    record_metadata: Any
+
+    def location(self) -> str:
+        meta = self.record_metadata
+        return meta.location() if hasattr(meta, "location") else str(meta)
 
 
 def _to_class_indices(arr: np.ndarray) -> np.ndarray:
@@ -41,6 +60,7 @@ class Evaluation:
         self.label_names = list(labels) if labels is not None else None
         self.confusion: Optional[ConfusionMatrix] = None
         self._examples = 0
+        self._predictions: List[Prediction] = []
 
     # -- accumulation ---------------------------------------------------
 
@@ -50,13 +70,21 @@ class Evaluation:
             self.confusion = ConfusionMatrix(range(size))
             self.num_classes = size
 
-    def eval(self, labels, predictions, mask=None) -> None:
+    def eval(self, labels, predictions, mask=None, metadata=None) -> None:
         """Accumulate one minibatch.
 
         labels: one-hot [b, c] (or [b, t, c] time series) or ints [b];
         predictions: probabilities, same leading shape; mask: optional
         per-row [b] / per-timestep [b, t] 0/1 array — masked rows are
         excluded (parity: ``Evaluation.evalTimeSeries`` masking).
+
+        metadata: optional per-example provenance, one entry per row
+        (parity: ``Evaluation.java:195`` ``eval(labels, out, metadata)``).
+        When given, every example's (actual, predicted, metadata) triple is
+        retained so ``get_prediction_errors()`` can answer *which source
+        records* were misclassified. Per-example metadata attribution is a
+        row-wise concept, so it requires per-example labels ([b, c] or
+        [b]), not flattened time series.
         """
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
@@ -77,6 +105,11 @@ class Evaluation:
             self.num_classes = n_out
 
         if labels.ndim == 3:  # [b, t, c] time series → flatten active steps
+            if metadata is not None:
+                raise ValueError(
+                    "metadata attribution needs per-example labels "
+                    "([b, c] or [b]); flatten time series yourself or "
+                    "evaluate without metadata")
             b, t, c = labels.shape
             labels2 = labels.reshape(b * t, c)
             preds2 = predictions.reshape(b * t, c)
@@ -88,9 +121,20 @@ class Evaluation:
         else:
             y_true = _to_class_indices(labels)
             y_pred = _to_class_indices(predictions)
+            metas = list(metadata) if metadata is not None else None
+            if metas is not None and len(metas) != len(y_true):
+                raise ValueError(
+                    f"metadata has {len(metas)} entries for "
+                    f"{len(y_true)} examples")
             if mask is not None:
                 keep = np.asarray(mask).reshape(-1) > 0
                 y_true, y_pred = y_true[keep], y_pred[keep]
+                if metas is not None:
+                    metas = [m for m, k in zip(metas, keep) if k]
+            if metas is not None:
+                self._predictions.extend(
+                    Prediction(int(a), int(p), m)
+                    for a, p, m in zip(y_true, y_pred, metas))
 
         self.confusion.add_batch(y_true, y_pred)
         self._examples += len(y_true)
@@ -105,6 +149,24 @@ class Evaluation:
             self.num_classes = other.num_classes
         self.confusion.merge(other.confusion)
         self._examples += other._examples
+        self._predictions.extend(other._predictions)
+
+    # -- per-example metadata attribution -------------------------------
+    # parity: reference eval/meta/Prediction.java + Evaluation.java:1013
+    # (getPredictionErrors) / :1044 (getPredictionsByActualClass) /
+    # :1075 (getPredictionByPredictedClass)
+
+    def get_prediction_errors(self) -> List[Prediction]:
+        """All misclassified examples seen with metadata, in eval order —
+        answers "WHICH source records did the model get wrong"."""
+        return [p for p in self._predictions
+                if p.actual_class != p.predicted_class]
+
+    def get_predictions_by_actual_class(self, cls: int) -> List[Prediction]:
+        return [p for p in self._predictions if p.actual_class == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int) -> List[Prediction]:
+        return [p for p in self._predictions if p.predicted_class == cls]
 
     # -- per-class counts ----------------------------------------------
 

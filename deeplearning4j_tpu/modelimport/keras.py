@@ -22,8 +22,10 @@ from ..nn.conf.builders import NeuralNetConfiguration
 from ..nn.conf.inputs import InputType
 from ..nn.conf.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
-    DropoutLayer, EmbeddingLayer, OutputLayer, SubsamplingLayer)
-from ..nn.conf.recurrent import GravesLSTM, LastTimeStepLayer
+    DropoutLayer, EmbeddingLayer, OutputLayer, RnnOutputLayer,
+    SubsamplingLayer)
+from ..nn.conf.recurrent import (
+    GravesLSTM, LastTimeStepLayer, TimeDistributedDenseLayer)
 
 _ACTIVATIONS = {
     "linear": "identity", "relu": "relu", "softmax": "softmax",
@@ -199,7 +201,7 @@ class KerasModelImport:
                 # suffix the name so weight lookup skips them
                 entries.append((name if li == 0 else f"{name}__aux{li}",
                                 cls if li == 0 else "_Aux", l))
-            if cls == "Dense":
+            if cls in ("Dense", "TimeDistributedDense", "TimeDistributed"):
                 last_dense_idx = len(entries) - 1
         if last_dense_idx >= 0:
             # final Dense → OutputLayer so the net can train/evaluate
@@ -214,9 +216,16 @@ class KerasModelImport:
                     if c == "Activation":
                         act = l.activation
                 entries = entries[:last_dense_idx + 1]
-                entries[last_dense_idx] = (name, "Dense", OutputLayer(
-                    n_in=dense.n_in, n_out=dense.n_out, activation=act,
-                    loss=loss))
+                if cls == "Dense":
+                    entries[last_dense_idx] = (name, "Dense", OutputLayer(
+                        n_in=dense.n_in, n_out=dense.n_out, activation=act,
+                        loss=loss))
+                else:
+                    # final time-distributed dense → RnnOutputLayer (the
+                    # reference's per-timestep output path)
+                    entries[last_dense_idx] = (name, cls, RnnOutputLayer(
+                        n_in=dense.n_in, n_out=dense.n_out, activation=act,
+                        loss=loss))
         lb = builder
         for _, _, layer in entries:
             lb = lb.layer(layer)
@@ -395,6 +404,20 @@ class KerasModelImport:
             if not cfg.get("return_sequences", False):
                 return [lstm, LastTimeStepLayer()]
             return lstm
+        if cls == "TimeDistributedDense":  # keras 1
+            n_out = cfg.get("units") or cfg.get("output_dim")
+            return TimeDistributedDenseLayer(n_out=int(n_out), activation=act)
+        if cls == "TimeDistributed":  # keras 2 wrapper
+            inner = cfg.get("layer") or {}
+            if inner.get("class_name") != "Dense":
+                raise ValueError(
+                    "only TimeDistributed(Dense) import is supported, got "
+                    f"TimeDistributed({inner.get('class_name')!r})")
+            icfg = inner.get("config", {})
+            n_out = icfg.get("units") or icfg.get("output_dim")
+            return TimeDistributedDenseLayer(
+                n_out=int(n_out),
+                activation=_map_activation(icfg.get("activation")))
         if cls == "Embedding":
             return EmbeddingLayer(n_in=int(cfg["input_dim"]),
                                   n_out=int(cfg["output_dim"]),
@@ -458,7 +481,9 @@ class KerasModelImport:
     def _translate_weights(cls: str, arrays: Dict[str, np.ndarray],
                            lname: str, fmt: str) -> Dict[str, np.ndarray]:
         a = arrays
-        if cls == "Dense":
+        if cls in ("Dense", "TimeDistributedDense", "TimeDistributed"):
+            # TimeDistributed(Dense) stores plain Dense kernel/bias under
+            # the wrapper layer's name
             out = {}
             k = a.get("kernel", a.get(f"{lname}_W"))
             b = a.get("bias", a.get(f"{lname}_b"))

@@ -105,18 +105,59 @@ class WordVectorSerializer:
                 f.write(f"{word} {vec}\n")
 
     @staticmethod
-    def load_txt_vectors(path: str) -> SequenceVectors:
+    def write_word_vectors_binary(model: SequenceVectors, path: str) -> None:
+        """Google word2vec C binary format write (parity:
+        ``WordVectorSerializer.writeWordVectors`` binary branch): ASCII
+        header ``"<n_words> <dim>\\n"``, then per word the UTF-8 word bytes,
+        a space, ``dim`` little-endian float32s, and a newline — the layout
+        the original word2vec C tool emits and the ecosystem interchanges."""
         opener = gzip.open if path.endswith(".gz") else open
-        with opener(path, "rt", encoding="utf-8") as f:
-            header = f.readline().split()
+        syn0 = np.asarray(model._syn0(), dtype="<f4")
+        with opener(path, "wb") as f:
+            f.write(f"{model.vocab.num_words()} {model.layer_size}\n"
+                    .encode("utf-8"))
+            for i, word in enumerate(model.vocab.words()):
+                f.write(word.encode("utf-8") + b" ")
+                f.write(syn0[i].tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def load_google_model(path: str, binary: bool = True) -> SequenceVectors:
+        """Load a Google-format word2vec model (parity:
+        ``WordVectorSerializer.java:109-152`` ``loadGoogleModel``): binary
+        (word2vec C ``fwrite`` float32 layout) or text."""
+        if not binary:
+            return WordVectorSerializer.load_txt_vectors(path)
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            header = f.readline().decode("utf-8").split()
             n_words, dim = int(header[0]), int(header[1])
+            vec_bytes = dim * 4
             words, vecs = [], []
-            for line in f:
-                parts = line.rstrip("\n").split(" ")
-                if len(parts) < dim + 1:
-                    continue
-                words.append(parts[0])
-                vecs.append(np.asarray(parts[1:dim + 1], dtype=np.float32))
+            for _ in range(n_words):
+                # word bytes run to the separating space (skip leading
+                # newlines some writers leave after the previous vector)
+                chars = []
+                while True:
+                    ch = f.read(1)
+                    if not ch:
+                        raise ValueError(
+                            f"truncated binary model: read {len(words)} of "
+                            f"{n_words} words")
+                    if ch == b" ":
+                        break
+                    if ch != b"\n":
+                        chars.append(ch)
+                words.append(b"".join(chars).decode("utf-8"))
+                buf = f.read(vec_bytes)
+                if len(buf) != vec_bytes:
+                    raise ValueError(
+                        f"truncated vector for word {words[-1]!r}")
+                vecs.append(np.frombuffer(buf, dtype="<f4").copy())
+        return WordVectorSerializer._from_words_vecs(words, vecs, dim)
+
+    @staticmethod
+    def _from_words_vecs(words, vecs, dim) -> SequenceVectors:
         model = SequenceVectors(layer_size=dim)
         vocab = VocabCache()
         for w in words:
@@ -131,3 +172,18 @@ class WordVectorSerializer:
         model.vocab = vocab
         model.params = {"syn0": jnp.asarray(syn0)}
         return model
+
+    @staticmethod
+    def load_txt_vectors(path: str) -> SequenceVectors:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as f:
+            header = f.readline().split()
+            n_words, dim = int(header[0]), int(header[1])
+            words, vecs = [], []
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) < dim + 1:
+                    continue
+                words.append(parts[0])
+                vecs.append(np.asarray(parts[1:dim + 1], dtype=np.float32))
+        return WordVectorSerializer._from_words_vecs(words, vecs, dim)

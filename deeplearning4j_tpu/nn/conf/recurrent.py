@@ -242,3 +242,37 @@ class LastTimeStepLayer(Layer):
         t = x.shape[1]
         idx = t - 1 - jnp.argmax(jnp.flip(mask > 0, axis=1), axis=1)
         return x[jnp.arange(x.shape[0]), idx], state
+
+
+@register_layer("time_distributed_dense")
+@dataclasses.dataclass
+class TimeDistributedDenseLayer(BaseRecurrentLayer):
+    """Dense applied independently at every timestep: [b, t, n_in] →
+    [b, t, n_out] (parity: the reference's Keras ``TimeDistributedDense``
+    import, ``modelimport/keras/LayerConfiguration.java:43``, which it
+    realizes as a DenseLayer in an RnnToFeedForward/FeedForwardToRnn
+    sandwich). TPU-native: no reshape sandwich — one batched einsum keeps
+    the time axis so XLA sees a single [b*t, n_in]×[n_in, n_out] MXU
+    matmul without layout round-trips. Inherits BaseRecurrentLayer's
+    input handling (FeedForwardToRnn / CnnToRnn preprocessors)."""
+
+    def param_shapes(self, policy=None):
+        return {"W": (self.n_in, self.n_out), "b": (self.n_out,)}
+
+    def init_params(self, key, policy=None):
+        policy = policy or _dtypes.default_policy()
+        dt = policy.param_dtype
+        w = init_weights(key, (self.n_in, self.n_out),
+                         self.weight_init or "XAVIER",
+                         fan_in=self.n_in, fan_out=self.n_out,
+                         distribution=self.dist, dtype=dt)
+        b = jnp.full((self.n_out,), float(self.bias_init or 0.0), dt)
+        return {"W": w, "b": b}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None, policy=None):
+        policy = policy or _dtypes.default_policy()
+        x = self._dropout_in(x, train, rng)
+        xc, wc = policy.cast_to_compute(x, params["W"])
+        z = jnp.einsum("bti,io->bto", xc, wc) + params["b"].astype(xc.dtype)
+        return self._act()(z), state

@@ -620,7 +620,9 @@ class MultiLayerNetwork:
             ms = mask[:, start:end] if (mask is not None and mask.ndim >= 2) else mask
             loss = self._step_and_update(xs, ys, ms, rnn_state=rnn_state)
             rnn_state = self._last_rnn_carry
-        self._fire_iteration(x.shape[0], loss)
+            # one iteration (and listener firing) per TBPTT segment, same as
+            # the graph runtime and the reference's doTruncatedBPTT
+            self._fire_iteration(x.shape[0], loss)
         return loss
 
     def _zero_rnn_carry(self, batch):

@@ -278,3 +278,93 @@ class TestFunctionalImport:
         _keras2_functional(p2, rng)
         assert isinstance(KerasModelImport.import_model(p2),
                           ComputationGraph)
+
+
+class TestTimeDistributedDense:
+    """TimeDistributedDense import (parity: reference
+    modelimport/keras/LayerConfiguration.java:43) — keras-1 class and the
+    keras-2 TimeDistributed(Dense) wrapper both map onto the
+    time-axis-preserving dense path."""
+
+    def _fixture(self, path, rng):
+        """keras-2: TimeDistributed(Dense(4, relu)) ->
+        TimeDistributed(Dense(3, softmax)), input [None, 6, 5]."""
+        W1 = rng.normal(size=(5, 4)).astype(np.float32)
+        b1 = rng.normal(size=(4,)).astype(np.float32)
+        W2 = rng.normal(size=(4, 3)).astype(np.float32)
+        b2 = rng.normal(size=(3,)).astype(np.float32)
+        config = {
+            "class_name": "Sequential",
+            "config": {"name": "seq", "layers": [
+                {"class_name": "TimeDistributed", "config": {
+                    "name": "td_1", "batch_input_shape": [None, 6, 5],
+                    "layer": {"class_name": "Dense", "config": {
+                        "name": "dense_1", "units": 4,
+                        "activation": "relu"}}}},
+                {"class_name": "TimeDistributed", "config": {
+                    "name": "td_2",
+                    "layer": {"class_name": "Dense", "config": {
+                        "name": "dense_2", "units": 3,
+                        "activation": "softmax"}}}},
+            ]},
+        }
+        with h5py.File(path, "w") as f:
+            f.attrs["model_config"] = json.dumps(config).encode()
+            mw = f.create_group("model_weights")
+            g1 = mw.create_group("td_1")
+            g1.create_dataset("td_1/kernel:0", data=W1)
+            g1.create_dataset("td_1/bias:0", data=b1)
+            g2 = mw.create_group("td_2")
+            g2.create_dataset("td_2/kernel:0", data=W2)
+            g2.create_dataset("td_2/bias:0", data=b2)
+        return W1, b1, W2, b2
+
+    def test_keras2_wrapper_forward_matches_numpy(self, rng, tmp_path):
+        from deeplearning4j_tpu.nn.conf.recurrent import (
+            TimeDistributedDenseLayer)
+        p = str(tmp_path / "td.h5")
+        W1, b1, W2, b2 = self._fixture(p, rng)
+        net = KerasModelImport.import_sequential_model(p)
+        assert isinstance(net.layers[0], TimeDistributedDenseLayer)
+        x = rng.normal(size=(2, 6, 5)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        h = np.maximum(x @ W1 + b1, 0)
+        logits = h @ W2 + b2
+        ref = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        ref /= ref.sum(axis=-1, keepdims=True)
+        assert out.shape == (2, 6, 3)
+        assert np.allclose(out, ref, atol=1e-5), np.abs(out - ref).max()
+
+    def test_keras1_class_and_training(self, rng, tmp_path):
+        """keras-1 TimeDistributedDense with flat weight names; imported
+        net trains per-timestep."""
+        W = rng.normal(size=(5, 3)).astype(np.float32)
+        b = rng.normal(size=(3,)).astype(np.float32)
+        config = {
+            "class_name": "Sequential",
+            "config": {"name": "seq", "layers": [
+                {"class_name": "TimeDistributedDense", "config": {
+                    "name": "tdd_1", "output_dim": 3,
+                    "activation": "softmax",
+                    "batch_input_shape": [None, 4, 5]}},
+            ]},
+        }
+        p = tmp_path / "td1.h5"
+        with h5py.File(str(p), "w") as f:
+            f.attrs["model_config"] = json.dumps(config).encode()
+            mw = f.create_group("model_weights")
+            g = mw.create_group("tdd_1")
+            g.create_dataset("tdd_1_W", data=W)
+            g.create_dataset("tdd_1_b", data=b)
+        net = KerasModelImport.import_sequential_model(str(p), train=True)
+        x = rng.normal(size=(3, 4, 5)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        logits = x @ W + b
+        ref = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        ref /= ref.sum(axis=-1, keepdims=True)
+        assert np.allclose(out, ref, atol=1e-5)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (3, 4))]
+        s0 = float(net.fit_batch(x, y))
+        for _ in range(5):
+            s = float(net.fit_batch(x, y))
+        assert np.isfinite(s) and s < s0 * 2

@@ -140,6 +140,45 @@ class TestWord2Vec:
             assert np.allclose(loaded.get_word_vector(w),
                                sv.get_word_vector(w), atol=1e-5)
 
+    def test_google_binary_serde_roundtrip(self, tmp_path):
+        """Google word2vec C binary format (parity:
+        WordVectorSerializer.java:109-152 loadGoogleModel binary=true):
+        write binary, load it back, and agree with the txt-loaded model
+        bit-for-bit on vectors and on words_nearest."""
+        corpus = _synthetic_corpus(50)
+        sv = SequenceVectors(layer_size=12, epochs=1, seed=4).fit(corpus)
+        pb = str(tmp_path / "vecs.bin")
+        pt = str(tmp_path / "vecs.txt")
+        WordVectorSerializer.write_word_vectors_binary(sv, pb)
+        WordVectorSerializer.write_word_vectors(sv, pt)
+        from_bin = WordVectorSerializer.load_google_model(pb, binary=True)
+        from_txt = WordVectorSerializer.load_google_model(pt, binary=False)
+        assert from_bin.vocab.num_words() == sv.vocab.num_words()
+        for w in ["cat", "car", "dog"]:
+            # binary is exact float32; txt goes through %.6f text
+            assert np.allclose(from_bin.get_word_vector(w),
+                               sv.get_word_vector(w), atol=0)
+            assert np.allclose(from_bin.get_word_vector(w),
+                               from_txt.get_word_vector(w), atol=1e-5)
+        assert from_bin.words_nearest("cat", top=5) \
+            == from_txt.words_nearest("cat", top=5)
+
+    def test_google_binary_gzip_and_truncation(self, tmp_path):
+        corpus = _synthetic_corpus(30)
+        sv = SequenceVectors(layer_size=8, epochs=1, seed=7).fit(corpus)
+        pgz = str(tmp_path / "vecs.bin.gz")
+        WordVectorSerializer.write_word_vectors_binary(sv, pgz)
+        loaded = WordVectorSerializer.load_google_model(pgz)
+        assert np.allclose(loaded.get_word_vector("cat"),
+                           sv.get_word_vector("cat"), atol=0)
+        # truncated file fails loudly, not silently
+        raw = (tmp_path / "trunc.bin")
+        import gzip as _gz
+        with _gz.open(pgz, "rb") as f:
+            raw.write_bytes(f.read()[:-10])
+        with pytest.raises(ValueError, match="truncated"):
+            WordVectorSerializer.load_google_model(str(raw))
+
     def test_subsampling_runs(self):
         corpus = _synthetic_corpus(50)
         sv = SequenceVectors(layer_size=8, sample=1e-3, epochs=1, seed=5)
