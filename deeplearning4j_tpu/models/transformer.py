@@ -7,9 +7,12 @@ residual adds, trained like any other ComputationGraph (one jitted step,
 works with remat, and the attention op auto-routes to the Pallas flash
 kernel at long sequence lengths — see ops/flash_attention.py).
 
-Layout bookkeeping: dense layers auto-flatten recurrent activations to
-[b·t, f] (``RnnToFeedForwardPreProcessor``); a ``PreprocessorVertex``
-rebuilds [b, t, f] before each residual add so both arms agree.
+TPU-native layout: every vertex is time-axis-preserving ([b, t, f] end to
+end — ``TimeDistributedDenseLayer`` einsums keep the time dim, no
+flatten/rebuild reshapes), so under a sequence-sharded mesh
+(``parallel.sequence.SequenceParallelGraphTrainer``) every op partitions
+trivially over the time axis and attention rides the ring — no reshape of
+a sharded dim, no gather.
 
 Inputs are one-hot [b, t, vocab]; ``RnnOutputLayer`` gives per-step
 softmax + mcxent, so training/eval/serde all ride the standard paths.
@@ -19,10 +22,10 @@ from __future__ import annotations
 
 from ..nn.conf.attention import SelfAttentionLayer
 from ..nn.conf.builders import NeuralNetConfiguration
-from ..nn.conf.graph import ElementWiseVertex, PreprocessorVertex
+from ..nn.conf.graph import ElementWiseVertex
 from ..nn.conf.inputs import InputType
-from ..nn.conf.layers import DenseLayer, LayerNormalization, RnnOutputLayer
-from ..nn.conf.preprocessors import FeedForwardToRnnPreProcessor
+from ..nn.conf.layers import LayerNormalization, RnnOutputLayer
+from ..nn.conf.recurrent import TimeDistributedDenseLayer
 
 
 def transformer_lm(vocab_size: int, *, n_layers: int = 4,
@@ -39,12 +42,10 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
           .dtype(dtype)
           .graph_builder()
           .add_inputs("in"))
-    gb.add_layer("embed", DenseLayer(n_in=vocab_size, n_out=d_model,
-                                     activation="identity"), "in")
-    gb.add_vertex("embed_rnn",
-                  PreprocessorVertex(FeedForwardToRnnPreProcessor()),
-                  "embed")
-    prev = "embed_rnn"
+    gb.add_layer("embed",
+                 TimeDistributedDenseLayer(n_in=vocab_size, n_out=d_model,
+                                           activation="identity"), "in")
+    prev = "embed"
     for i in range(n_layers):
         b = f"blk{i}"
         gb.add_layer(f"{b}_ln1", LayerNormalization(), prev)
@@ -55,17 +56,16 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
         gb.add_vertex(f"{b}_res1", ElementWiseVertex(op="add"),
                       prev, f"{b}_attn")
         gb.add_layer(f"{b}_ln2", LayerNormalization(), f"{b}_res1")
-        gb.add_layer(f"{b}_ff1", DenseLayer(n_in=d_model, n_out=d_ff,
-                                            activation="relu"),
+        gb.add_layer(f"{b}_ff1",
+                     TimeDistributedDenseLayer(n_in=d_model, n_out=d_ff,
+                                               activation="relu"),
                      f"{b}_ln2")
-        gb.add_layer(f"{b}_ff2", DenseLayer(n_in=d_ff, n_out=d_model,
-                                            activation="identity"),
+        gb.add_layer(f"{b}_ff2",
+                     TimeDistributedDenseLayer(n_in=d_ff, n_out=d_model,
+                                               activation="identity"),
                      f"{b}_ff1")
-        gb.add_vertex(f"{b}_ff_rnn",
-                      PreprocessorVertex(FeedForwardToRnnPreProcessor()),
-                      f"{b}_ff2")
         gb.add_vertex(f"{b}_res2", ElementWiseVertex(op="add"),
-                      f"{b}_res1", f"{b}_ff_rnn")
+                      f"{b}_res1", f"{b}_ff2")
         prev = f"{b}_res2"
     gb.add_layer("final_ln", LayerNormalization(), prev)
     gb.add_layer("out", RnnOutputLayer(n_in=d_model, n_out=vocab_size,

@@ -8,8 +8,9 @@ same way the recurrent layers do (masked keys are not attended, masked
 steps output 0).
 
 The single-device path uses the fused ``ops.attention.dot_product_attention``;
-under a sequence-sharded mesh the same math runs as ring attention
-(``parallel.sequence.SequenceParallelTrainer``).
+inside an ``ops.attention.sequence_sharding`` context (entered by
+``parallel.sequence.SequenceParallelGraphTrainer`` around its step trace)
+the same math runs as ring attention over the sequence-sharded mesh.
 """
 
 from __future__ import annotations
@@ -94,7 +95,9 @@ class SelfAttentionLayer(Layer):
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None, policy=None):
-        from ...ops.attention import dot_product_attention
+        from ...ops.attention import (active_sequence_sharding,
+                                      dot_product_attention,
+                                      make_ring_attention)
         policy = policy or _dtypes.default_policy()
         x = self._dropout_in(x, train, rng)
         xc, wqkv = policy.cast_to_compute(x, params["Wqkv"])
@@ -102,7 +105,23 @@ class SelfAttentionLayer(Layer):
         h = self.n_heads
         qkv = (xc @ wqkv).reshape(b, t, 3, h, f // h)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        att = dot_product_attention(q, k, v, causal=self.causal, mask=mask)
+        seq_ctx = active_sequence_sharding()
+        if seq_ctx is not None:
+            # sequence-parallel route: the time axis is sharded over the
+            # mesh — the one op that mixes timesteps runs as ring attention
+            # (K/V shards rotate over ppermute; see parallel/sequence.py)
+            mesh, seq_axis, batch_axis = seq_ctx
+            if mask is not None:
+                raise ValueError(
+                    "sequence-parallel attention does not support key "
+                    "masks yet — train unmasked or without the "
+                    "sequence_sharding context")
+            ring = make_ring_attention(mesh, seq_axis, causal=self.causal,
+                                       batch_axis=batch_axis)
+            att = ring(q, k, v)
+        else:
+            att = dot_product_attention(q, k, v, causal=self.causal,
+                                        mask=mask)
         wo = params["Wo"].astype(att.dtype)
         out = att.reshape(b, t, f) @ wo + params["b"].astype(att.dtype)
         out = self._act(self.activation or "identity")(out)

@@ -128,17 +128,21 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
 
 
 def make_ring_attention(mesh, axis_name: str = "seq", *,
-                        causal: bool = False):
+                        causal: bool = False, batch_axis: Optional[str] = None):
     """shard_map-wrapped ring attention: takes GLOBAL [b, t, h, d] arrays
     sharded (or shardable) over `axis_name` on the time axis, returns the
-    global attention output with the same sharding."""
+    global attention output with the same sharding.
+
+    ``batch_axis``: optional mesh axis the BATCH dim is data-parallel over
+    (2-D dp x sp meshes) — each dp slice runs its own independent ring over
+    ``axis_name``; without it a dp-sharded batch would be gathered."""
     try:
         from jax import shard_map
     except ImportError:
         from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
@@ -146,3 +150,48 @@ def make_ring_attention(mesh, axis_name: str = "seq", *,
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
 
     return fn
+
+
+# --------------------------------------------------------------------------
+# sequence-sharding context: how DSL layers discover an active seq mesh
+# --------------------------------------------------------------------------
+
+_SEQ_SHARDING: Optional[tuple] = None
+
+
+class sequence_sharding:
+    """Trace-time context that routes ``SelfAttentionLayer`` (and any other
+    time-mixing op that opts in) to ring attention over ``seq_axis``.
+
+    Usage — activate around the *trace* of a step function::
+
+        with sequence_sharding(mesh, "seq", batch_axis="dp"):
+            loss = jax.jit(step)(params, x, y)   # first call traces here
+
+    The context is read at trace time (like the flash-attention flag): the
+    chosen route is baked into the compiled program, which is exactly what
+    a sharded trainer wants — its step is always ring-routed, while the
+    same model object used outside the context keeps its single-device
+    program.
+    """
+
+    def __init__(self, mesh, seq_axis: str = "seq",
+                 batch_axis: Optional[str] = None):
+        self.value = (mesh, seq_axis, batch_axis)
+
+    def __enter__(self):
+        global _SEQ_SHARDING
+        self._prev = _SEQ_SHARDING
+        _SEQ_SHARDING = self.value
+        return self
+
+    def __exit__(self, *exc):
+        global _SEQ_SHARDING
+        _SEQ_SHARDING = self._prev
+        return False
+
+
+def active_sequence_sharding() -> Optional[tuple]:
+    """(mesh, seq_axis, batch_axis) if a sequence_sharding context is
+    active, else None."""
+    return _SEQ_SHARDING

@@ -25,7 +25,8 @@ from .distributed import (global_mesh, host_local_batch, initialize,
                           is_initialized, process_count, process_index)
 from .expert import ExpertParallelTrainer
 from .mesh import create_mesh, data_parallel_mesh, mesh_devices
-from .pipeline import PipelineParallelTrainer
+from .pipeline import GraphPipelineTrainer, PipelineParallelTrainer
+from .sequence import SequenceParallelGraphTrainer
 from .tensor import TensorParallelTrainer
 from .training_master import (ParameterAveragingTrainingMaster,
                               SyncTrainingMaster, Trainer, TrainingMaster)
@@ -36,4 +37,5 @@ __all__ = ["ParallelWrapper", "create_mesh", "data_parallel_mesh",
            "host_local_batch", "process_count", "process_index",
            "TrainingMaster", "Trainer", "SyncTrainingMaster",
            "ParameterAveragingTrainingMaster", "TensorParallelTrainer",
-           "PipelineParallelTrainer", "ExpertParallelTrainer"]
+           "PipelineParallelTrainer", "GraphPipelineTrainer",
+           "SequenceParallelGraphTrainer", "ExpertParallelTrainer"]
