@@ -361,6 +361,18 @@ def bench_flash_attention() -> dict:
     f_flash = jax.jit(lambda q, k, v: jnp.sum(
         flash_attention(q, k, v, True).astype(jnp.float32)))
 
+    def _grad(attn):
+        def f(q, k, v):
+            g = jax.grad(lambda q, k, v: jnp.sum(
+                attn(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2))(
+                    q, k, v)
+            return sum(jnp.sum(x.astype(jnp.float32)) for x in g)
+        return jax.jit(f)
+
+    g_xla = _grad(lambda q, k, v: dot_product_attention(q, k, v,
+                                                        causal=True))
+    g_flash = _grad(lambda q, k, v: flash_attention(q, k, v, True))
+
     def _t(f, iters=15):
         float(f(q, k, v))
         t0 = time.perf_counter()
@@ -370,18 +382,23 @@ def bench_flash_attention() -> dict:
         return (time.perf_counter() - t0) / iters * 1e3
 
     prior_flag = os.environ.get("DL4JTPU_FLASH_ATTENTION")
-    os.environ["DL4JTPU_FLASH_ATTENTION"] = "0"   # force f_xla's route
+    os.environ["DL4JTPU_FLASH_ATTENTION"] = "0"   # force the XLA route
     try:
         ms_xla = _t(f_xla)
+        ms_xla_grad = _t(g_xla, iters=10)
     finally:
         if prior_flag is None:
             os.environ.pop("DL4JTPU_FLASH_ATTENTION", None)
         else:
             os.environ["DL4JTPU_FLASH_ATTENTION"] = prior_flag
     ms_flash = _t(f_flash)
+    ms_flash_grad = _t(g_flash, iters=10)
     flops = 4.0 * b * h * t * t * d / 2  # causal
     return {"xla_ms": round(ms_xla, 2), "flash_ms": round(ms_flash, 2),
             "speedup": round(ms_xla / ms_flash, 2),
+            "xla_grad_ms": round(ms_xla_grad, 2),
+            "flash_grad_ms": round(ms_flash_grad, 2),
+            "grad_speedup": round(ms_xla_grad / ms_flash_grad, 2),
             "flash_tflops": round(flops / ms_flash / 1e9, 1),
             "seq_len": t, "dtype": "bfloat16"}
 

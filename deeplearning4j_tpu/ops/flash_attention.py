@@ -1,4 +1,4 @@
-"""Pallas flash-attention for TPU — forward kernel + blockwise backward.
+"""Pallas flash-attention for TPU — forward AND backward kernels.
 
 Forward: the [t, t] score matrix never exists anywhere. The grid holds one
 [block_q, block_k] logits tile at a time; per-q-block online-softmax
@@ -13,16 +13,18 @@ NEG_INF logits, and rows with NO attendable keys (leading padding under a
 causal mask, all-zero mask rows) output 0 — same semantics as the guarded
 XLA path in ``ops.attention``.
 
-Backward: the standard flash backward over [512, 512] tiles — P is
-recomputed from the saved lse; the dq pass is vmapped over q-blocks (scan
-over k), the dk/dv pass vmapped over k-blocks (scan over q). Peak memory
-is O(t·block + t·d), so TRAINING runs at sequence lengths where XLA's
-attention cannot even compile. Gradients match the dense path (CPU
-interpret + on-chip parity tests).
+Backward: Pallas kernels for both passes — P is recomputed per tile from
+the saved lse; the dq pass streams K/V blocks while the dq tile
+accumulates in VMEM scratch; the fused dk/dv pass recomputes each tile's
+P/dS once for both grads. Peak memory is O(t·block + t·d), so TRAINING
+runs at sequence lengths where XLA's attention cannot even compile.
+Gradients match the dense path (CPU interpret + on-chip parity tests).
+A JAX-blockwise fallback backward remains behind ``DL4JTPU_FLASH_BWD=jax``.
 
-Measured numbers live in PERF.md ("Pallas flash attention" section —
-the single source of truth): forward 1.8-2.8× over the XLA fused path at
-t≥4096, backward 1.8×-1.1×, and t=16384 runs fwd+bwd where XLA OOMs.
+Measured numbers live in PERF.md ("Pallas flash attention" + "Pallas
+backward kernels" sections — the single source of truth): fwd+grad
+2.2-2.3× over the XLA fused path at t≥4096 (forward alone 1.8-2.8×), and
+t=16384 runs fwd+bwd where XLA OOMs.
 
 Routing (``ops.attention.dot_product_attention``): auto at t ≥ 4096 on
 the TPU backend; ``DL4JTPU_FLASH_ATTENTION=1`` forces it on (any length),
@@ -327,6 +329,162 @@ def _flash_bwd_btd(q, k, v, mk, out, lse, dout, *, scale, causal, block_q,
 
 
 # --------------------------------------------------------------------------
+# Pallas backward kernels: dq pass + fused dk/dv pass
+# --------------------------------------------------------------------------
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, valid, *, scale, causal,
+              q_offset, k_offset, block_q, block_k):
+    """Recompute one [block_q, block_k] tile's (P, dS) from the saved lse
+    (standard flash backward). Rows with lse=NEG_INF (no attendable keys)
+    get P=0, not exp(overflow)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    lse_safe = jnp.where(lse <= _HALF_NEG, 0.0, lse)
+    p = jnp.where((lse <= _HALF_NEG)[:, None], 0.0,
+                  jnp.exp(s - lse_safe[:, None]))
+    p = jnp.where(valid, p, 0.0)                    # valid: [1, bk] bool
+    if causal:
+        rows = q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = k_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(rows >= cols, p, 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mk_ref, lse_ref, dl_ref, do_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, nk):
+    """dq pass: grid (bh, nq, nk), k sequential — the dq tile accumulates
+    in VMEM scratch while Pallas streams (double-buffers) K/V blocks."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    relevant = (kj * block_k <= qi * block_q + block_q - 1) if causal \
+        else (kj >= 0)
+
+    @pl.when(relevant)
+    def _accumulate():
+        _, ds = _bwd_p_ds(
+            q_ref[0].astype(jnp.float32), k_ref[0].astype(jnp.float32),
+            v_ref[0].astype(jnp.float32), do_ref[0].astype(jnp.float32),
+            lse_ref[0, :, 0], dl_ref[0, :, 0],
+            mk_ref[0, pl.ds(kj, 1), :] > 0,
+            scale=scale, causal=causal, q_offset=qi * block_q,
+            k_offset=kj * block_k, block_q=block_q, block_k=block_k)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _write():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, mk_ref, q_ref, lse_ref, dl_ref, do_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, nq):
+    """Fused dk/dv pass: grid (bh, nk, nq), q sequential — P and dS are
+    recomputed ONCE per tile and feed both dk (dSᵀ·q) and dv (Pᵀ·dout)."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    relevant = (qi * block_q + block_q - 1 >= kj * block_k) if causal \
+        else (qi >= 0)
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_p_ds(
+            q, k_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            do, lse_ref[0, :, 0], dl_ref[0, :, 0],
+            mk_ref[0, pl.ds(kj, 1), :] > 0,
+            scale=scale, causal=causal, q_offset=qi * block_q,
+            k_offset=kj * block_k, block_q=block_q, block_k=block_k)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _write():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_btd_pallas(q, k, v, mk, out, lse, dout, *, scale, causal,
+                          block_q, block_k, interpret, n_heads):
+    """[bh, t, d] grads via the two Pallas passes. Same math as
+    ``_flash_bwd_btd`` (the JAX-blockwise fallback, kept for
+    ``DL4JTPU_FLASH_BWD=jax``) with the tile loops lowered to Mosaic:
+    measured ≥1.5× over the XLA backward at bf16 t=8192 (PERF.md)."""
+    bh, t, d = q.shape
+    if t % block_k:
+        block_k = block_q
+    nq, nk = t // block_q, t // block_k
+    h_ = n_heads
+    # delta = rowsum(dout * out): one cheap fused elementwise pass in XLA
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[..., None]                       # [bh, t, 1]
+    lse3 = lse[..., None]                                     # [bh, t, 1]
+    mkt = mk.astype(jnp.float32).reshape(-1, nk, block_k)
+
+    i_spec = lambda name: pl.BlockSpec((1, block_q, d),
+                                       lambda b, i, j: (b, i, 0))
+    i_col = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    j_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    mk_spec = pl.BlockSpec((1, nk, block_k), lambda b, i, j: (b // h_, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[i_spec("q"), j_spec, j_spec, mk_spec, i_col, i_col,
+                  i_spec("do")],
+        out_specs=i_spec("dq"),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, mkt, lse3, delta, dout)
+
+    # dk/dv pass: i (q-blocks) is the SEQUENTIAL (last) grid dim
+    jk_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    iq_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    iq_col = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    mk2_spec = pl.BlockSpec((1, nk, block_k),
+                            lambda b, j, i: (b // h_, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[jk_spec, jk_spec, mk2_spec, iq_spec, iq_col, iq_col,
+                  iq_spec],
+        out_specs=(jk_spec, jk_spec),
+        out_shape=(jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(k, v, mkt, q, lse3, delta, dout)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
 # public op with custom_vjp
 # --------------------------------------------------------------------------
 
@@ -358,24 +516,43 @@ def _core_fwd_rule(q, k, v, mask, causal, scale, block_q, interpret):
 
 
 def _core_bwd_rule(causal, scale, block_q, interpret, res, g):
+    import os
     q, k, v, mask, out, lse = res
     b, t, h, d = q.shape
     s = _resolve_scale(scale, d)
     to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    mk = jnp.repeat(mask.astype(jnp.float32), h, axis=0)
-    # backward tiles are independent of the forward block size; wider
-    # tiles keep the MXU busy (measured per-step at bf16 t=8192 / f32
-    # t=4096: 128-tiles ~1.5x slower than 512, 1024x1024 another ~20%
-    # faster than 512x512 and within 5% of the plateau at both sizes)
-    if t % 1024 == 0:
-        bq_bwd = bk_bwd = 1024
-    elif t % 512 == 0:
-        bq_bwd = bk_bwd = 512
+    if os.environ.get("DL4JTPU_FLASH_BWD") == "jax":
+        # JAX-blockwise fallback (same math, lax.scan tiles)
+        mk = jnp.repeat(mask.astype(jnp.float32), h, axis=0)
+        if t % 1024 == 0:
+            bq_bwd = bk_bwd = 1024
+        elif t % 512 == 0:
+            bq_bwd = bk_bwd = 512
+        else:
+            bq_bwd = bk_bwd = block_q or 128
+        dq, dk, dv = _flash_bwd_btd(
+            to_btd(q), to_btd(k), to_btd(v), mk, to_btd(out), lse,
+            to_btd(g), scale=s, causal=causal, block_q=bq_bwd,
+            block_k=bk_bwd)
     else:
-        bq_bwd = bk_bwd = block_q or 128
-    dq, dk, dv = _flash_bwd_btd(
-        to_btd(q), to_btd(k), to_btd(v), mk, to_btd(out), lse, to_btd(g),
-        scale=s, causal=causal, block_q=bq_bwd, block_k=bk_bwd)
+        # Pallas backward kernels. Tile choice (PERF.md sweep): 256² is
+        # ~2× slower than the 512/1024 band, which is flat within the
+        # measurement noise — but 1024² allocates ~18MB of [bq,bk] f32
+        # intermediates on the VMEM stack and OOMs the 16MB scoped limit
+        # in some surrounding programs, so take 512×1024 (~8MB, fastest
+        # safe point) when t allows
+        if t % 1024 == 0:
+            bq_bwd, bk_bwd = 512, 1024
+        elif t % 512 == 0:
+            bq_bwd = bk_bwd = 512
+        elif t % 256 == 0:
+            bq_bwd = bk_bwd = 256
+        else:
+            bq_bwd = bk_bwd = block_q or 128
+        dq, dk, dv = _flash_bwd_btd_pallas(
+            to_btd(q), to_btd(k), to_btd(v), mask, to_btd(out), lse,
+            to_btd(g), scale=s, causal=causal, block_q=bq_bwd,
+            block_k=bk_bwd, interpret=interpret, n_heads=h)
     back = lambda a: a.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     return back(dq), back(dk), back(dv), jnp.zeros_like(mask,
                                                         dtype=jnp.float32)
@@ -386,8 +563,9 @@ _flash_core.defvjp(_core_fwd_rule, _core_bwd_rule)
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     interpret=False, mask=None):
-    """[b, t, h, d] attention with the Pallas forward and blockwise
-    backward. t must divide by ``block_q`` (default: auto — 128-row
+    """[b, t, h, d] attention with Pallas forward and backward kernels
+    (``DL4JTPU_FLASH_BWD=jax`` selects the lax.scan blockwise backward
+    instead). t must divide by ``block_q`` (default: auto — 128-row
     granularity, upgraded to wider tiles when t and the VMEM budget allow;
     an explicit ``block_q`` is used as-is). ``mask``: optional [b, t_kv]
     key-validity mask (1=attend); rows with no attendable keys output 0."""

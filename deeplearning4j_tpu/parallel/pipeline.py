@@ -291,6 +291,12 @@ class GraphPipelineTrainer:
         if batch_axis is not None and batch_axis not in mesh.axis_names:
             raise ValueError(f"batch_axis {batch_axis!r} not in mesh "
                              f"{mesh.axis_names}")
+        if getattr(net.conf, "backprop_type", None) == "truncated_bptt":
+            # same invariant as fit_scan/fit_repeated (_reject_tbptt)
+            raise ValueError(
+                "GraphPipelineTrainer does not chunk truncated BPTT; use "
+                "the single-device fit(), or train full-sequence by "
+                "clearing backprop_type")
         self.net = net
         self.mesh = mesh
         self.axis = axis

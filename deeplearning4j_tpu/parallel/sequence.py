@@ -159,6 +159,14 @@ class SequenceParallelGraphTrainer:
         if seq_axis not in mesh.axis_names:
             raise ValueError(f"seq_axis {seq_axis!r} not in mesh "
                              f"{mesh.axis_names}")
+        if getattr(net.conf, "backprop_type", None) == "truncated_bptt":
+            # same invariant as fit_scan/fit_repeated (_reject_tbptt):
+            # refuse loudly rather than silently running one full-sequence
+            # BPTT update where the single-device path would chunk
+            raise ValueError(
+                "SequenceParallelGraphTrainer does not chunk truncated "
+                "BPTT; use the single-device fit(), or train full-sequence "
+                "by clearing backprop_type")
         self.net = net
         self.mesh = mesh
         self.seq_axis = seq_axis

@@ -291,3 +291,17 @@ class TestReviewRegressions:
         x, y = _data(batch=6, t=8)
         with pytest.raises(ValueError, match="not divisible"):
             pp.score_for(x, y)
+
+    def test_tbptt_config_rejected(self):
+        """Both trainers refuse truncated-BPTT configs loudly (the
+        _reject_tbptt invariant) instead of silently running
+        full-sequence updates."""
+        conf = transformer_lm(V, n_layers=2, d_model=16, n_heads=2,
+                              d_ff=32, updater="sgd")
+        conf.backprop_type = "truncated_bptt"
+        conf.tbptt_fwd_length = 4
+        net = ComputationGraph(conf).init()
+        with pytest.raises(ValueError, match="truncated BPTT"):
+            SequenceParallelGraphTrainer(net, create_mesh({"seq": 8}))
+        with pytest.raises(ValueError, match="truncated BPTT"):
+            GraphPipelineTrainer(net, create_mesh({"pp": 2}))
