@@ -72,8 +72,10 @@ def _conv_flops_nhwc(h, w, c_in, c_out, kh, kw, stride):
 
 
 def _resnet50_train_flops_per_example(image=224, n_classes=1000) -> float:
-    """Analytic fwd FLOPs for standard bottleneck ResNet-50 (≈4.1 GFLOP fwd
-    at 224², matching the published figure); train ≈ 3× fwd."""
+    """Analytic fwd FLOPs for standard bottleneck ResNet-50 — ≈8.2 GFLOP
+    fwd at 224² (2 FLOPs per MAC × the published ≈4.1 GMACs); train ≈ 3×
+    fwd. Peak in the MFU denominator uses the same 2-FLOPs-per-MAC
+    convention, so the ratio is convention-consistent."""
     total = 0.0
     f, h = 0.0, image
     # stem 7x7/2 ch 3->64

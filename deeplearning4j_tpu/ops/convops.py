@@ -60,12 +60,32 @@ def conv2d(x, w, stride=(1, 1), padding: Padding = (0, 0), dilation=(1, 1),
     step (63.3 vs 47.5 ms/step — PERF.md r4). Kept because it is exact
     (f64 parity suite in tests/test_convdw.py) and other TPU generations /
     conv mixes may rank the two differently.
+
+    ``DL4JTPU_CONV_1X1=dot`` lowers 1x1 convs (no dilation/groups/padding)
+    as channel contractions (``lax.dot_general``) instead of
+    ``conv_general_dilated`` — stride>1 becomes a free slice first. Same
+    math; a different HLO for XLA to schedule (PERF.md r5).
     """
+    if (_1x1_mode() == "dot" and w.shape[0] == w.shape[1] == 1
+            and groups == 1 and tuple(dilation) == (1, 1)
+            and (isinstance(padding, str)  # SAME==VALID for a 1x1 kernel
+                 or tuple(padding) == (0, 0))):
+        sh, sw = tuple(stride)
+        if sh > 1 or sw > 1:
+            x = x[:, ::sh, ::sw, :]
+        return lax.dot_general(
+            x, w[0, 0], (((3,), (0,)), ((), ())),
+            preferred_element_type=preferred_dtype)
     if (_dw_mode() == "matmul" and groups == 1
             and tuple(dilation) == (1, 1)):
         return _conv2d_mmdw(x, w, tuple(stride), padding, preferred_dtype)
     return _conv2d_raw(x, w, stride, padding, dilation, groups,
                        preferred_dtype)
+
+
+def _1x1_mode() -> str:
+    import os
+    return os.environ.get("DL4JTPU_CONV_1X1", "")
 
 
 def _dw_mode() -> str:

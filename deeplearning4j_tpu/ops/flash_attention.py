@@ -447,7 +447,18 @@ def _flash_bwd_btd_pallas(q, k, v, mk, out, lse, dout, *, scale, causal,
     i_spec = lambda name: pl.BlockSpec((1, block_q, d),
                                        lambda b, i, j: (b, i, 0))
     i_col = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
-    j_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    if causal:
+        # clamp the streamed K/V index map at the causal diagonal: the
+        # grid still visits post-diagonal steps (compute is pl.when-gated
+        # off), but a repeated block index lets Pallas elide the DMA —
+        # the backward analog of the forward kernel's loads-and-compute
+        # skip, halving streamed traffic at large t
+        def _kv_map(b, i, j):
+            return (b, jnp.minimum(
+                j, (i * block_q + block_q - 1) // block_k), 0)
+        j_spec = pl.BlockSpec((1, block_k, d), _kv_map)
+    else:
+        j_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     mk_spec = pl.BlockSpec((1, nk, block_k), lambda b, i, j: (b // h_, 0, 0))
 
     dq = pl.pallas_call(
@@ -464,8 +475,20 @@ def _flash_bwd_btd_pallas(q, k, v, mk, out, lse, dout, *, scale, causal,
 
     # dk/dv pass: i (q-blocks) is the SEQUENTIAL (last) grid dim
     jk_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    iq_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    iq_col = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    if causal:
+        # pre-diagonal q blocks contribute nothing to this k block —
+        # clamp their index map to the first relevant block (fetched
+        # once, then reused) so the skipped steps cost no DMA
+        def _q_map(b, j, i):
+            return (b, jnp.maximum(i, (j * block_k) // block_q), 0)
+
+        def _q_col_map(b, j, i):
+            return (b, jnp.maximum(i, (j * block_k) // block_q), 0)
+        iq_spec = pl.BlockSpec((1, block_q, d), _q_map)
+        iq_col = pl.BlockSpec((1, block_q, 1), _q_col_map)
+    else:
+        iq_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+        iq_col = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
     mk2_spec = pl.BlockSpec((1, nk, block_k),
                             lambda b, j, i: (b // h_, 0, 0))
     dk, dv = pl.pallas_call(

@@ -291,12 +291,6 @@ class GraphPipelineTrainer:
         if batch_axis is not None and batch_axis not in mesh.axis_names:
             raise ValueError(f"batch_axis {batch_axis!r} not in mesh "
                              f"{mesh.axis_names}")
-        if getattr(net.conf, "backprop_type", None) == "truncated_bptt":
-            # same invariant as fit_scan/fit_repeated (_reject_tbptt)
-            raise ValueError(
-                "GraphPipelineTrainer does not chunk truncated BPTT; use "
-                "the single-device fit(), or train full-sequence by "
-                "clearing backprop_type")
         self.net = net
         self.mesh = mesh
         self.axis = axis
@@ -444,6 +438,8 @@ class GraphPipelineTrainer:
         n_micro)."""
         net = self.net
         xs, ys = self._stage_batch(inputs), self._stage_batch(labels)
+        from .sequence import _reject_tbptt_chunking
+        _reject_tbptt_chunking(net, xs[0], "GraphPipelineTrainer.fit_batch")
         it = jnp.asarray(net._update_count, jnp.int32)
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, xs, ys, it)

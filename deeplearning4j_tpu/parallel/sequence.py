@@ -159,14 +159,6 @@ class SequenceParallelGraphTrainer:
         if seq_axis not in mesh.axis_names:
             raise ValueError(f"seq_axis {seq_axis!r} not in mesh "
                              f"{mesh.axis_names}")
-        if getattr(net.conf, "backprop_type", None) == "truncated_bptt":
-            # same invariant as fit_scan/fit_repeated (_reject_tbptt):
-            # refuse loudly rather than silently running one full-sequence
-            # BPTT update where the single-device path would chunk
-            raise ValueError(
-                "SequenceParallelGraphTrainer does not chunk truncated "
-                "BPTT; use the single-device fit(), or train full-sequence "
-                "by clearing backprop_type")
         self.net = net
         self.mesh = mesh
         self.seq_axis = seq_axis
@@ -241,6 +233,8 @@ class SequenceParallelGraphTrainer:
         divisible by the seq mesh axis; b by the batch axis if 2-D)."""
         net = self.net
         xs = [self._stage(x) for x in _as_list(inputs)]
+        _reject_tbptt_chunking(net, xs[0],
+                               "SequenceParallelGraphTrainer.fit_batch")
         ys = [self._stage(y) for y in _as_list(labels)]
         rng = _rng.fold_name(_rng.key(net.training.seed),
                              f"update_{net._update_count}")
@@ -258,3 +252,19 @@ class SequenceParallelGraphTrainer:
 
 def _as_list(v):
     return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+def _reject_tbptt_chunking(net, x, api: str) -> None:
+    """The sharded trainers run ONE full-sequence BPTT update per batch;
+    silently doing that where the single-device path would chunk
+    (truncated_bptt with T > tbptt_fwd_length) changes optimization
+    semantics — refuse loudly (the fit_scan/fit_repeated `_reject_tbptt`
+    invariant). Batches that fit in one chunk are semantically identical
+    and pass through."""
+    conf = net.conf
+    if (getattr(conf, "backprop_type", None) == "truncated_bptt"
+            and x.ndim >= 3 and x.shape[1] > conf.tbptt_fwd_length):
+        raise ValueError(
+            f"{api} does not chunk truncated BPTT (T={x.shape[1]} > "
+            f"tbptt_fwd_length={conf.tbptt_fwd_length}); use the "
+            "single-device fit(), or pre-chunk the sequences")

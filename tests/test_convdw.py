@@ -83,3 +83,39 @@ def test_flag_routes_conv2d(monkeypatch):
     # dilated convs must keep the standard path (mmdw doesn't support them)
     convops.conv2d(x, w, (1, 1), "SAME", dilation=(2, 2))
     assert calls == [1]
+
+
+class TestConv1x1Dot:
+    """DL4JTPU_CONV_1X1=dot lowers 1x1 convs as channel contractions —
+    exact parity (values and gradients) with conv_general_dilated,
+    including the stride-2 shortcut case (PERF.md r5)."""
+
+    @pytest.mark.parametrize("stride", [(1, 1), (2, 2)])
+    def test_value_and_grad_parity(self, rng, monkeypatch, stride):
+        from deeplearning4j_tpu.ops import convops
+        x = jnp.asarray(rng.normal(size=(2, 8, 8, 6)).astype(np.float64))
+        w = jnp.asarray(rng.normal(size=(1, 1, 6, 10)).astype(np.float64))
+
+        def loss(fn):
+            return lambda x, w: jnp.sum(fn(x, w) ** 2)
+
+        ref_fn = lambda x, w: convops.conv2d(x, w, stride, (0, 0))
+        ref = ref_fn(x, w)
+        gref = jax.grad(loss(ref_fn), argnums=(0, 1))(x, w)
+        monkeypatch.setenv("DL4JTPU_CONV_1X1", "dot")
+        dot_fn = lambda x, w: convops.conv2d(x, w, stride, (0, 0))
+        out = dot_fn(x, w)
+        gdot = jax.grad(loss(dot_fn), argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-12, atol=1e-12)
+        for a, b in zip(gref, gdot):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-12, atol=1e-12)
+
+    def test_non_1x1_unaffected(self, rng, monkeypatch):
+        from deeplearning4j_tpu.ops import convops
+        monkeypatch.setenv("DL4JTPU_CONV_1X1", "dot")
+        x = jnp.asarray(rng.normal(size=(2, 8, 8, 3)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(3, 3, 3, 4)).astype(np.float32))
+        out = convops.conv2d(x, w, (1, 1), (1, 1))
+        assert out.shape == (2, 8, 8, 4)

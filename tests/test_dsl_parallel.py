@@ -292,16 +292,28 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="not divisible"):
             pp.score_for(x, y)
 
-    def test_tbptt_config_rejected(self):
-        """Both trainers refuse truncated-BPTT configs loudly (the
-        _reject_tbptt invariant) instead of silently running
-        full-sequence updates."""
+    def test_tbptt_chunking_rejected(self):
+        """Batches a truncated-BPTT config would CHUNK (T > fwd_length)
+        are refused loudly (the _reject_tbptt invariant) instead of
+        silently running one full-sequence update; batches that fit in a
+        single chunk are semantically identical and pass through."""
         conf = transformer_lm(V, n_layers=2, d_model=16, n_heads=2,
                               d_ff=32, updater="sgd")
         conf.backprop_type = "truncated_bptt"
         conf.tbptt_fwd_length = 4
+        x, y = _data()           # T=16 > 4 -> must chunk -> reject
         net = ComputationGraph(conf).init()
+        sp = SequenceParallelGraphTrainer(net, create_mesh({"seq": 8}))
         with pytest.raises(ValueError, match="truncated BPTT"):
-            SequenceParallelGraphTrainer(net, create_mesh({"seq": 8}))
+            sp.fit_batch(x, y)
+        conf2 = transformer_lm(V, n_layers=2, d_model=16, n_heads=2,
+                               d_ff=32, updater="sgd")
+        conf2.backprop_type = "truncated_bptt"
+        conf2.tbptt_fwd_length = 4
+        net2 = ComputationGraph(conf2).init()
+        pp = GraphPipelineTrainer(net2, create_mesh({"pp": 2}), n_micro=2)
         with pytest.raises(ValueError, match="truncated BPTT"):
-            GraphPipelineTrainer(net, create_mesh({"pp": 2}))
+            pp.fit_batch(x, y)
+        # T <= fwd_length: single chunk == full-sequence BPTT -> allowed
+        conf2.tbptt_fwd_length = 16
+        assert np.isfinite(float(pp.fit_batch(x, y)))

@@ -6,6 +6,7 @@ test coverage)."""
 import math
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nlp import (
     AsyncLabelAwareIterator, BagOfWordsVectorizer, BasicLabelAwareIterator,
@@ -189,3 +190,56 @@ class TestReviewRegressions:
         # producer must NOT have walked all 300 docs to reach a sentinel
         assert n_after_reset < 50
         assert len(list(it)) == 300
+
+
+class TestThirdPartyTokenizerSPI:
+    """The tokenizer SPI accepts a REAL third-party tokenizer (HuggingFace
+    `transformers` WordPiece), retiring the UIMA/Kuromoji exclusion
+    argument with evidence: the reference's pluggable-tokenizer seam
+    (`TokenizerFactory.java`) is the extension point, and an industrial
+    tokenizer drops in without framework changes."""
+
+    def _hf_factory(self, tmp_path):
+        transformers = pytest.importorskip("transformers")
+        from deeplearning4j_tpu.nlp.tokenization import (Tokenizer,
+                                                         TokenizerFactory)
+        vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "cat", "dog",
+                 "sat", "on", "mat", "play", "##s", "##ing"]
+        vf = tmp_path / "vocab.txt"
+        vf.write_text("\n".join(vocab) + "\n")
+        hf = transformers.BertTokenizerFast(vocab_file=str(vf),
+                                            do_lower_case=True)
+
+        class HFTokenizer(Tokenizer):
+            def __init__(self, text):
+                self.text = text
+
+            def get_tokens(self):
+                return hf.tokenize(self.text)
+
+        class HFTokenizerFactory(TokenizerFactory):
+            def create(self, text):
+                return HFTokenizer(text)
+
+        return HFTokenizerFactory()
+
+    def test_wordpiece_through_spi(self, tmp_path):
+        tf = self._hf_factory(tmp_path)
+        toks = tf.create("The cats sat playing on the mat").get_tokens()
+        # real WordPiece behavior: lowercasing, subword splits, [UNK]s
+        assert toks[0] == "the"
+        assert "##s" in toks or "##ing" in toks
+        assert all(isinstance(t, str) for t in toks)
+
+    def test_word2vec_trains_through_hf_tokenizer(self, tmp_path):
+        from deeplearning4j_tpu.nlp import (CollectionSentenceIterator,
+                                            Word2Vec)
+        tf = self._hf_factory(tmp_path)
+        sents = ["the cat sat on the mat", "the dog sat on the mat",
+                 "the cat play the dog"] * 20
+        w2v = (Word2Vec.builder().layer_size(8).window_size(2)
+               .min_word_frequency(1).epochs(1).seed(0)
+               .iterate(CollectionSentenceIterator(sents))
+               .tokenizer_factory(tf).build()).fit()
+        assert w2v.has_word("cat")
+        assert w2v.get_word_vector("cat").shape == (8,)
