@@ -439,7 +439,7 @@ class GraphPipelineTrainer:
         net = self.net
         xs, ys = self._stage_batch(inputs), self._stage_batch(labels)
         from .sequence import _reject_tbptt_chunking
-        _reject_tbptt_chunking(net, xs[0], "GraphPipelineTrainer.fit_batch")
+        _reject_tbptt_chunking(net, xs, "GraphPipelineTrainer.fit_batch")
         it = jnp.asarray(net._update_count, jnp.int32)
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, xs, ys, it)

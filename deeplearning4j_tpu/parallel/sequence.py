@@ -233,7 +233,7 @@ class SequenceParallelGraphTrainer:
         divisible by the seq mesh axis; b by the batch axis if 2-D)."""
         net = self.net
         xs = [self._stage(x) for x in _as_list(inputs)]
-        _reject_tbptt_chunking(net, xs[0],
+        _reject_tbptt_chunking(net, xs,
                                "SequenceParallelGraphTrainer.fit_batch")
         ys = [self._stage(y) for y in _as_list(labels)]
         rng = _rng.fold_name(_rng.key(net.training.seed),
@@ -254,17 +254,16 @@ def _as_list(v):
     return list(v) if isinstance(v, (list, tuple)) else [v]
 
 
-def _reject_tbptt_chunking(net, x, api: str) -> None:
+def _reject_tbptt_chunking(net, xs, api: str) -> None:
     """The sharded trainers run ONE full-sequence BPTT update per batch;
     silently doing that where the single-device path would chunk
     (truncated_bptt with T > tbptt_fwd_length) changes optimization
-    semantics — refuse loudly (the fit_scan/fit_repeated `_reject_tbptt`
-    invariant). Batches that fit in one chunk are semantically identical
-    and pass through."""
-    conf = net.conf
-    if (getattr(conf, "backprop_type", None) == "truncated_bptt"
-            and x.ndim >= 3 and x.shape[1] > conf.tbptt_fwd_length):
-        raise ValueError(
-            f"{api} does not chunk truncated BPTT (T={x.shape[1]} > "
-            f"tbptt_fwd_length={conf.tbptt_fwd_length}); use the "
-            "single-device fit(), or pre-chunk the sequences")
+    semantics — refuse loudly. Delegates to the net's OWN
+    ``_reject_tbptt`` (graph nets scan ALL inputs for the temporal axis;
+    a first input may be static [b, f]) so the predicate cannot drift
+    from the single-device invariant. Batches that fit in one chunk are
+    semantically identical and pass through."""
+    if hasattr(net, "topo_order"):          # ComputationGraph: list input
+        net._reject_tbptt(xs, api)
+    else:                                   # MultiLayerNetwork: one array
+        net._reject_tbptt(xs[0], api)

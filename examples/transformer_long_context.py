@@ -45,23 +45,25 @@ def main(smoke: bool = False):
     acc = (pred[:, T // 2:] == ids[:, T // 2 + 1:]).mean()
     print(f"next-token accuracy (2nd half): {acc:.3f}")
 
-    # the same block trained with the time axis sharded over a mesh —
-    # ring attention carries K/V around the devices
+    # the SAME DSL model trained with the time axis sharded over a mesh —
+    # SelfAttentionLayer routes to ring attention (K/V rotate around the
+    # devices) via the sequence_sharding trace context
     import jax
-    from deeplearning4j_tpu.parallel import create_mesh
-    from deeplearning4j_tpu.parallel.sequence import SequenceParallelTrainer
+    from deeplearning4j_tpu.parallel import (SequenceParallelGraphTrainer,
+                                             create_mesh)
     n = jax.device_count()
     if n == 1:
         print("sequence-parallel half skipped: 1 device (simulate a mesh "
               "with XLA_FLAGS=--xla_force_host_platform_device_count=8 "
               "JAX_PLATFORMS=cpu)")
     else:
-        tr = SequenceParallelTrainer(d_model=16, d_ff=32, n_heads=2,
-                                     vocab=V, mesh=create_mesh({"seq": n}),
-                                     learning_rate=0.5, seed=1)
+        sp_net = ComputationGraph(transformer_lm(
+            V, n_layers=2, d_model=16, n_heads=2, d_ff=32,
+            updater="adam", learning_rate=1e-2)).init()
+        tr = SequenceParallelGraphTrainer(sp_net, create_mesh({"seq": n}))
         xs, ys, _ = cyclic_batch(V, 4, 8 * n)
         losses = [float(tr.fit_batch(xs, ys)) for _ in range(40)]
-        print(f"sequence-parallel ({n} devices): loss "
+        print(f"sequence-parallel DSL transformer ({n} devices): loss "
               f"{losses[0]:.3f} -> {losses[-1]:.3f}")
 
 

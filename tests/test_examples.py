@@ -35,6 +35,15 @@ def test_example_smoke(script):
         # preload can transiently lose a race for the device tunnel while
         # other tests/benches hold it (also covers OOM signal kills)
         first = f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
+    if proc.returncode == 0 and first is not None:
+        # a pass that NEEDED its retry must be loud, not silent: a real
+        # intermittent bug hiding as "tunnel flake" shows up here as this
+        # warning recurring for the same script across runs — treat that
+        # as a failure and investigate (r4 verdict weak #6)
+        import warnings
+        warnings.warn(
+            f"{script} passed only on retry — first attempt:\n{first}",
+            stacklevel=2)
     assert proc.returncode == 0, (
         f"{script} failed twice.\nFirst attempt: {first}\n"
         f"Second attempt (rc={proc.returncode}):\n"
