@@ -405,6 +405,33 @@ def bench_flash_attention() -> dict:
             "seq_len": t, "dtype": "bfloat16"}
 
 
+def bench_transformer_lm() -> dict:
+    """Long-context transformer LM (DSL model, flash auto-routed at
+    T=4096) via fit_repeated — k on-chip steps per dispatch, so the
+    number is the true training step, not the dev tunnel's per-dispatch
+    latency (PERF.md r5 methodology note)."""
+    from deeplearning4j_tpu.models import transformer_lm
+    from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+    V, T, b, k = 8, 4096, 4, 16
+    net = ComputationGraph(transformer_lm(
+        V, n_layers=2, d_model=256, n_heads=4, d_ff=1024,
+        learning_rate=3e-4)).init()
+    ids = np.array([[(i + j) % V for i in range(T + 1)] for j in range(b)])
+    eye = np.eye(V, dtype=np.float32)
+    x, y = eye[ids[:, :-1]], eye[ids[:, 1:]]
+    np.asarray(net.fit_repeated([x], [y], k))  # warmup/compile
+    rounds = 3
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        losses = net.fit_repeated([x], [y], k)
+    np.asarray(losses)
+    step_s = (time.perf_counter() - t0) / (rounds * k)
+    return {"step_ms": round(step_s * 1e3, 2),
+            "tokens_per_sec": round(b * T / step_s, 1),
+            "batch": b, "seq_len": T, "d_model": 256, "n_layers": 2}
+
+
 def main() -> None:
     import jax
     device = str(jax.devices()[0].device_kind)
@@ -419,6 +446,7 @@ def main() -> None:
     _run_config(out, "lstm", bench_lstm)
     _run_config(out, "word2vec", bench_word2vec)
     _run_config(out, "flash_attention", bench_flash_attention)
+    _run_config(out, "transformer_lm", bench_transformer_lm)
 
     if resnet_res is not None:
         out.update({
