@@ -872,12 +872,17 @@ class ComputationGraph:
     # ------------------------------------------------------------------
 
     def evaluate(self, data, labels=None):
+        """Classification evaluation; DataSet iterators carrying
+        ``example_metadata`` flow provenance into the returned Evaluation
+        (``get_prediction_errors()`` — parity: ``Evaluation.java:195``)."""
         from ..eval import Evaluation
+        from ..util.batching import iter_batches
         ev = Evaluation()
-        for x, y, m in self._as_batches(data, labels):
+        for x, y, m, meta in iter_batches(data, labels, with_meta=True):
             out = self.output(jnp.asarray(np.asarray(x)))
             ev.eval(np.asarray(y), np.asarray(out),
-                    mask=None if m is None else np.asarray(m))
+                    mask=None if m is None else np.asarray(m),
+                    metadata=meta)
         if hasattr(data, "reset"):
             data.reset()
         return ev

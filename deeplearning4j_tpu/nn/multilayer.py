@@ -731,12 +731,22 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
 
     def evaluate(self, data, labels=None):
-        """Classification evaluation over an iterator or (x, y) arrays."""
+        """Classification evaluation over an iterator or (x, y) arrays.
+
+        When the iterator yields DataSets carrying ``example_metadata``
+        (``RecordReaderDataSetIterator(collect_metadata=True)``), the
+        provenance flows into the returned Evaluation — ask it
+        ``get_prediction_errors()`` for WHICH source records were
+        misclassified (parity: ``Evaluation.java:195`` eval-with-metadata
+        driven from the iterator)."""
         from ..eval import Evaluation
+        from ..util.batching import iter_batches
         ev = Evaluation()
-        for x, y, m in self._as_batches(data, labels, None):
+        for x, y, m, meta in iter_batches(data, labels, with_meta=True):
             out = self.output(jnp.asarray(x))
-            ev.eval(np.asarray(y), np.asarray(out), mask=None if m is None else np.asarray(m))
+            ev.eval(np.asarray(y), np.asarray(out),
+                    mask=None if m is None else np.asarray(m),
+                    metadata=meta)
         if hasattr(data, "reset"):
             data.reset()
         return ev
