@@ -52,6 +52,22 @@ print(json.dumps({"platform": plat, "score": score,
 """
 
 
+
+def _run_accel_child(child_src, *argv, timeout=420):
+    """Run an accelerator-side child with the suite's CPU pins (and the
+    framework's kernel-routing toggles) stripped; returns the child's
+    last-stdout-line JSON. ONE copy of the scaffolding for every
+    backend-parity test so child environments cannot drift."""
+    drop = ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64",
+            "DL4JTPU_FLASH_ATTENTION", "DL4JTPU_FLASH_BWD")
+    env = {k: v for k, v in os.environ.items() if k not in drop}
+    proc = subprocess.run(
+        [sys.executable, "-c", child_src % {"repo": _REPO}, *map(str, argv)],
+        capture_output=True, text=True, env=env, timeout=timeout)
+    assert proc.returncode == 0, f"accelerator child failed:\n{proc.stderr}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def _conf():
     from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -83,14 +99,7 @@ class TestBackendParity:
         np.savez(data_path, x=x, y=y)
         out_path = tmp_path / "tpu_out.npz"
 
-        env = {k: v for k, v in os.environ.items()
-               if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")}
-        proc = subprocess.run(
-            [sys.executable, "-c", _CHILD % {"repo": _REPO},
-             str(conf_path), str(data_path), str(out_path)],
-            capture_output=True, text=True, env=env, timeout=420)
-        assert proc.returncode == 0, f"accelerator child failed:\n{proc.stderr}"
-        info = json.loads(proc.stdout.strip().splitlines()[-1])
+        info = _run_accel_child(_CHILD, conf_path, data_path, out_path)
         if info["platform"] == "cpu":
             pytest.skip("no accelerator platform available — backend-parity "
                         "test needs the TPU harness")
@@ -109,3 +118,55 @@ class TestBackendParity:
         assert info["score"] == pytest.approx(cpu_score, rel=1e-4)
         # one SGD step: compiled update path agrees across backends
         assert info["score_after"] == pytest.approx(cpu_score_after, rel=1e-3)
+
+
+_FLASH_CHILD = r"""
+import json, sys
+import jax, jax.numpy as jnp
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+sys.path.insert(0, %(repo)r)
+plat = jax.devices()[0].platform
+if plat == "cpu":
+    print(json.dumps({"platform": "cpu"}))
+    sys.exit(0)
+import os
+from deeplearning4j_tpu.ops.attention import dot_product_attention
+from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+d = np.load(sys.argv[1])
+q, k, v = (jnp.asarray(d[n]) for n in ("q", "k", "v"))
+
+def gradsum(attn):
+    def f(q, k, v):
+        return jnp.sum(jnp.tanh(attn(q, k, v)))  # bounded loss, f32
+    return jax.grad(f, argnums=(0, 1, 2))
+
+os.environ["DL4JTPU_FLASH_ATTENTION"] = "0"
+g_xla = jax.jit(gradsum(lambda q, k, v: dot_product_attention(
+    q, k, v, causal=True)))(q, k, v)
+del os.environ["DL4JTPU_FLASH_ATTENTION"]
+g_flash = jax.jit(gradsum(lambda q, k, v: flash_attention(
+    q, k, v, True)))(q, k, v)
+diffs = [float(jnp.max(jnp.abs(a - b))) for a, b in zip(g_xla, g_flash)]
+scale = [float(jnp.max(jnp.abs(a))) for a in g_xla]
+print(json.dumps({"platform": plat, "diffs": diffs, "scale": scale}))
+"""
+
+
+class TestFlashBackwardOnChip:
+    def test_pallas_backward_matches_xla_on_chip(self, rng, tmp_path):
+        """The Pallas dq/dkv kernels vs XLA autodiff ON THE REAL CHIP at a
+        size that engages the 512x1024 tile dispatch (the CPU interpret
+        tests can't see Mosaic lowering bugs). f32, causal."""
+        q = rng.normal(size=(1, 2048, 2, 64)).astype(np.float32)
+        k = rng.normal(size=(1, 2048, 2, 64)).astype(np.float32)
+        v = rng.normal(size=(1, 2048, 2, 64)).astype(np.float32)
+        data_path = tmp_path / "qkv.npz"
+        np.savez(data_path, q=q, k=k, v=v)
+        info = _run_accel_child(_FLASH_CHILD, data_path)
+        if info["platform"] == "cpu":
+            pytest.skip("no accelerator platform available")
+        for name, diff, scale in zip("qkv", info["diffs"], info["scale"]):
+            assert diff <= 2e-3 * max(scale, 1.0), (
+                f"d{name} on-chip max diff {diff} vs grad scale {scale}")
