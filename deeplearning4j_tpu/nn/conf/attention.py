@@ -109,16 +109,21 @@ class SelfAttentionLayer(Layer):
         if seq_ctx is not None:
             # sequence-parallel route: the time axis is sharded over the
             # mesh — the one op that mixes timesteps runs as ring attention
-            # (K/V shards rotate over ppermute; see parallel/sequence.py)
+            # (K/V shards rotate over ppermute; see parallel/sequence.py).
+            # Key masks ride the ring too: each mask shard rotates with
+            # its K/V shard.
             mesh, seq_axis, batch_axis = seq_ctx
-            if mask is not None:
-                raise ValueError(
-                    "sequence-parallel attention does not support key "
-                    "masks yet — train unmasked or without the "
-                    "sequence_sharding context")
-            ring = make_ring_attention(mesh, seq_axis, causal=self.causal,
-                                       batch_axis=batch_axis)
-            att = ring(q, k, v)
+            if mask is None:
+                ring = make_ring_attention(mesh, seq_axis,
+                                           causal=self.causal,
+                                           batch_axis=batch_axis)
+                att = ring(q, k, v)
+            else:
+                ring = make_ring_attention(mesh, seq_axis,
+                                           causal=self.causal,
+                                           batch_axis=batch_axis,
+                                           with_mask=True)
+                att = ring(q, k, v, mask)
         else:
             att = dot_product_attention(q, k, v, causal=self.causal,
                                         mask=mask)

@@ -54,12 +54,13 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
 
 
 def _block_attend(q, k, v, m_prev, num_prev, den_prev, *, scale,
-                  q_offset, k_offset, causal):
+                  q_offset, k_offset, causal, key_mask=None):
     """One K/V block of online-softmax accumulation (flash-style).
 
     m/num/den carry the running max, weighted-value numerator, and
     normalizer. q_offset/k_offset are global time offsets of the local q
-    block and current k block (for causal masking across ring hops)."""
+    block and current k block (for causal masking across ring hops).
+    key_mask: optional [b, tk] validity of THIS k block's keys."""
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale   # [b,h,tq,tk]
     if causal:
         tq, tk = q.shape[1], k.shape[1]
@@ -67,6 +68,9 @@ def _block_attend(q, k, v, m_prev, num_prev, den_prev, *, scale,
         ki = k_offset + jnp.arange(tk)
         allow = qi[:, None] >= ki[None, :]
         logits = jnp.where(allow[None, None], logits, -jnp.inf)
+    if key_mask is not None:
+        logits = jnp.where(key_mask[:, None, None, :] > 0, logits,
+                           -jnp.inf)
     m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1))   # [b,h,tq]
     # guard: rows with no allowed keys yet keep -inf max → exp(0)=1 issues;
     # use where to keep them at zero contribution
@@ -82,7 +86,7 @@ def _block_attend(q, k, v, m_prev, num_prev, den_prev, *, scale,
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, mask=None):
     """Ring attention INSIDE a shard_map over `axis_name`.
 
     Each device holds a [b, t_local, h, d] shard of q/k/v (the global
@@ -90,6 +94,11 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     with ``ppermute`` while each device accumulates its local queries'
     attention online — full-sequence attention without ever materializing
     the [t, t] matrix or gathering the sequence.
+
+    ``mask``: optional [b, t_local] key-validity shard (1=attend) — it
+    rotates around the ring WITH its K/V shard, so padded keys anywhere in
+    the global sequence are excluded; fully-masked query rows output 0
+    (same semantics as ``dot_product_attention``).
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -108,34 +117,45 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     q_offset = idx * t_local
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    # mask is a trace-time condition: the unmasked ring keeps its original
+    # 5-tuple carry (no extra ppermute riding the hot path)
+    extra = () if mask is None else (mask.astype(jnp.float32),)
 
     def body(i, carry):
-        m, num, den, k_blk, v_blk = carry
+        m, num, den, k_blk, v_blk, *mk = carry
         # the block currently held came from device (idx - i) mod n
         src = jnp.mod(idx - i, n)
         k_offset = src * t_local
         m, num, den = _block_attend(
             q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
             m, num, den, scale=scale, q_offset=q_offset,
-            k_offset=k_offset, causal=causal)
+            k_offset=k_offset, causal=causal,
+            key_mask=mk[0] if mk else None)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return m, num, den, k_blk, v_blk
+        mk = tuple(jax.lax.ppermute(x, axis_name, perm) for x in mk)
+        return (m, num, den, k_blk, v_blk, *mk)
 
-    m, num, den, _, _ = jax.lax.fori_loop(0, n, body, (m, num, den, k, v))
+    m, num, den, *_ = jax.lax.fori_loop(
+        0, n, body, (m, num, den, k, v, *extra))
     out = num / jnp.maximum(den[..., None], 1e-30)          # [b,h,tq,d]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [b,tq,h,d]
 
 
 def make_ring_attention(mesh, axis_name: str = "seq", *,
-                        causal: bool = False, batch_axis: Optional[str] = None):
+                        causal: bool = False, batch_axis: Optional[str] = None,
+                        with_mask: bool = False):
     """shard_map-wrapped ring attention: takes GLOBAL [b, t, h, d] arrays
     sharded (or shardable) over `axis_name` on the time axis, returns the
     global attention output with the same sharding.
 
     ``batch_axis``: optional mesh axis the BATCH dim is data-parallel over
     (2-D dp x sp meshes) — each dp slice runs its own independent ring over
-    ``axis_name``; without it a dp-sharded batch would be gathered."""
+    ``axis_name``; without it a dp-sharded batch would be gathered.
+
+    ``with_mask=True`` returns ``fn(q, k, v, mask)`` where mask is the
+    GLOBAL [b, t] key-validity array (sharded over ``axis_name`` like the
+    time axis); mask shards rotate around the ring with their K/V."""
     try:
         from jax import shard_map
     except ImportError:
@@ -143,6 +163,16 @@ def make_ring_attention(mesh, axis_name: str = "seq", *,
     from jax.sharding import PartitionSpec as P
 
     spec = P(batch_axis, axis_name, None, None)
+    mspec = P(batch_axis, axis_name)
+
+    if with_mask:
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(spec, spec, spec, mspec),
+                           out_specs=spec)
+        def fn(q, k, v, mask):
+            return ring_attention(q, k, v, axis_name=axis_name,
+                                  causal=causal, mask=mask)
+        return fn
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)

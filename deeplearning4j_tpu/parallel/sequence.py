@@ -178,19 +178,20 @@ class SequenceParallelGraphTrainer:
         ctx = lambda: sequence_sharding(mesh, seq_axis, batch_axis)
 
         if self._is_graph:
-            def loss_call(params, states, inputs, labels, rng):
-                return net._loss_fn(params, states, inputs, labels, None,
+            def loss_call(params, states, inputs, labels, masks, rng):
+                return net._loss_fn(params, states, inputs, labels, masks,
                                     rng)
         else:
-            def loss_call(params, states, inputs, labels, rng):
+            def loss_call(params, states, inputs, labels, masks, rng):
                 return net._loss_fn(params, states, inputs[0], labels[0],
-                                    None, rng)
+                                    None if masks is None else masks[0],
+                                    rng)
 
-        def step(params, opt_state, states, inputs, labels, rng, it):
+        def step(params, opt_state, states, inputs, labels, masks, rng, it):
             with ctx():   # trace-time: bakes the ring route into the jit
                 (loss, new_states), grads = jax.value_and_grad(
                     loss_call, has_aux=True)(
-                        params, states, inputs, labels, rng)
+                        params, states, inputs, labels, masks, rng)
             grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
             deltas, opt_state = updater.update(grads, opt_state, it)
             params = _updaters.apply_updates(params, deltas)
@@ -228,19 +229,29 @@ class SequenceParallelGraphTrainer:
         outs = self._fwd(self.net.params, self._states(), xs)
         return outs[0] if len(outs) == 1 else outs
 
-    def fit_batch(self, inputs, labels) -> jax.Array:
+    def _stage_mask(self, m):
+        sh = NamedSharding(self.mesh, P(self.batch_axis, self.seq_axis))
+        return jax.device_put(jnp.asarray(m), sh)
+
+    def fit_batch(self, inputs, labels, masks=None) -> jax.Array:
         """One sequence-parallel update on GLOBAL [b, t, f] arrays (t
-        divisible by the seq mesh axis; b by the batch axis if 2-D)."""
+        divisible by the seq mesh axis; b by the batch axis if 2-D).
+        ``masks``: optional [b, t] sequence masks — mask shards rotate
+        around the attention ring with their K/V shards."""
         net = self.net
         xs = [self._stage(x) for x in _as_list(inputs)]
         _reject_tbptt_chunking(net, xs,
                                "SequenceParallelGraphTrainer.fit_batch")
         ys = [self._stage(y) for y in _as_list(labels)]
+        ms = (None if masks is None
+              else [None if m is None else self._stage_mask(m)
+                    for m in _as_list(masks)])
         rng = _rng.fold_name(_rng.key(net.training.seed),
                              f"update_{net._update_count}")
         it = jnp.asarray(net._update_count, jnp.int32)
         params, opt_state, new_states, loss = self._step(
-            net.params, net.updater_state, self._states(), xs, ys, rng, it)
+            net.params, net.updater_state, self._states(), xs, ys, ms,
+            rng, it)
         net.params = params
         net.updater_state = opt_state
         net._update_count += 1
