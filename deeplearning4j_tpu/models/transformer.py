@@ -31,12 +31,20 @@ from ..nn.conf.recurrent import TimeDistributedDenseLayer
 def transformer_lm(vocab_size: int, *, n_layers: int = 4,
                    d_model: int = 256, n_heads: int = 4, d_ff: int = 1024,
                    updater: str = "adam", learning_rate: float = 3e-4,
-                   seed: int = 42, dtype: str = "float32"):
+                   seed: int = 42, dtype: str = "float32",
+                   moe_experts: int = 0, moe_top_k: int = 2):
     """Causal LM: in-proj → n_layers × [ln → attention (+res) → ln → ffn
-    (+res)] → final ln → vocab head."""
+    (+res)] → final ln → vocab head.
+
+    ``moe_experts > 0`` replaces every block's dense FFN with a top-k
+    routed ``MoELayer`` (d_hidden=d_ff per expert, load-balancing aux loss
+    included in training) — the expert-parallel model family; shard the
+    expert dim over an ``ep`` mesh axis via
+    ``parallel.expert.ExpertParallelGraphTrainer``."""
     if d_model % n_heads:
         raise ValueError(f"d_model={d_model} not divisible by "
                          f"n_heads={n_heads}")
+    from ..nn.conf.moe import MoELayer
     gb = (NeuralNetConfiguration.builder()
           .seed(seed).updater(updater).learning_rate(learning_rate)
           .dtype(dtype)
@@ -56,16 +64,27 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
         gb.add_vertex(f"{b}_res1", ElementWiseVertex(op="add"),
                       prev, f"{b}_attn")
         gb.add_layer(f"{b}_ln2", LayerNormalization(), f"{b}_res1")
-        gb.add_layer(f"{b}_ff1",
-                     TimeDistributedDenseLayer(n_in=d_model, n_out=d_ff,
-                                               activation="relu"),
-                     f"{b}_ln2")
-        gb.add_layer(f"{b}_ff2",
-                     TimeDistributedDenseLayer(n_in=d_ff, n_out=d_model,
-                                               activation="identity"),
-                     f"{b}_ff1")
+        if moe_experts > 0:
+            gb.add_layer(f"{b}_moe",
+                         MoELayer(n_in=d_model, n_out=d_model,
+                                  d_hidden=d_ff, n_experts=moe_experts,
+                                  top_k=moe_top_k),
+                         f"{b}_ln2")
+            ff_out = f"{b}_moe"
+        else:
+            gb.add_layer(f"{b}_ff1",
+                         TimeDistributedDenseLayer(n_in=d_model,
+                                                   n_out=d_ff,
+                                                   activation="relu"),
+                         f"{b}_ln2")
+            gb.add_layer(f"{b}_ff2",
+                         TimeDistributedDenseLayer(n_in=d_ff,
+                                                   n_out=d_model,
+                                                   activation="identity"),
+                         f"{b}_ff1")
+            ff_out = f"{b}_ff2"
         gb.add_vertex(f"{b}_res2", ElementWiseVertex(op="add"),
-                      f"{b}_res1", f"{b}_ff2")
+                      f"{b}_res1", ff_out)
         prev = f"{b}_res2"
     gb.add_layer("final_ln", LayerNormalization(), prev)
     gb.add_layer("out", RnnOutputLayer(n_in=d_model, n_out=vocab_size,

@@ -401,6 +401,11 @@ class ComputationGraph:
             else:
                 new_states[name] = {}
         total = total + self._reg_penalty(params)
+        # layers may surface auxiliary objectives through their state
+        # (e.g. MoELayer's load-balancing loss, pre-scaled by aux_weight)
+        for st in new_states.values():
+            if "aux_loss" in st:
+                total = total + st["aux_loss"]
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)
         return total.astype(loss_dtype), new_states
@@ -443,6 +448,11 @@ class ComputationGraph:
                 params, name, hidden, label_map[name], None, vrng,
                 minibatch=mbs[self.conf.vertex_inputs[name][0]])
         total = total + self._reg_penalty(params)
+        # layers may surface auxiliary objectives through their state
+        # (e.g. MoELayer's load-balancing loss, pre-scaled by aux_weight)
+        for st in new_states.values():
+            if "aux_loss" in st:
+                total = total + st["aux_loss"]
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)
         return total.astype(loss_dtype), new_states

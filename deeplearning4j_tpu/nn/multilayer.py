@@ -338,6 +338,11 @@ class MultiLayerNetwork:
         denom = _losses.masked_denominator(out_mask, y, score_arr.shape[0])
         loss = jnp.sum(score_arr) / denom
         loss = loss + self._reg_penalty(params)
+        # layers may surface auxiliary objectives through their state
+        # (e.g. MoELayer's load-balancing loss, pre-scaled by aux_weight)
+        for st in new_states:
+            if "aux_loss" in st:
+                loss = loss + st["aux_loss"]
         # keep full precision under a float64 policy (gradient checking);
         # float32 otherwise (bf16 losses are too coarse for LR-sized steps)
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64

@@ -23,7 +23,7 @@ TPU-native design — two modes, both expressed as XLA SPMD programs over a
 
 from .distributed import (global_mesh, host_local_batch, initialize,
                           is_initialized, process_count, process_index)
-from .expert import ExpertParallelTrainer
+from .expert import ExpertParallelGraphTrainer, ExpertParallelTrainer
 from .mesh import create_mesh, data_parallel_mesh, mesh_devices
 from .pipeline import GraphPipelineTrainer, PipelineParallelTrainer
 from .sequence import SequenceParallelGraphTrainer
@@ -38,4 +38,5 @@ __all__ = ["ParallelWrapper", "create_mesh", "data_parallel_mesh",
            "TrainingMaster", "Trainer", "SyncTrainingMaster",
            "ParameterAveragingTrainingMaster", "TensorParallelTrainer",
            "PipelineParallelTrainer", "GraphPipelineTrainer",
-           "SequenceParallelGraphTrainer", "ExpertParallelTrainer"]
+           "SequenceParallelGraphTrainer", "ExpertParallelTrainer",
+           "ExpertParallelGraphTrainer"]

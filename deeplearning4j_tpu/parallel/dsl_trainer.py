@@ -1,0 +1,172 @@
+"""Shared machinery for mesh-sharded trainers that serve DSL models.
+
+One implementation of the jitted-donated-step-over-the-net's-own-loss
+pattern (the ``TensorParallelTrainer`` design), parameterized by what a
+mode shards: ``SequenceParallelGraphTrainer`` shards the time axis and
+enters the ring-attention trace context; ``ExpertParallelGraphTrainer``
+shards MoE expert dims. Both inherit the full contract — masks, TBPTT
+chunk rejection, listener/iteration accounting, ``output()`` — so the
+modes cannot drift from each other or from the single-device invariants.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import rng as _rng
+
+Pytree = Any
+
+
+def _as_list(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+def _reject_tbptt_chunking(net, xs, api: str) -> None:
+    """The sharded trainers run ONE full-sequence BPTT update per batch;
+    silently doing that where the single-device path would chunk
+    (truncated_bptt with T > tbptt_fwd_length) changes optimization
+    semantics — refuse loudly. Delegates to the net's OWN
+    ``_reject_tbptt`` (graph nets scan ALL inputs for the temporal axis;
+    a first input may be static [b, f]) so the predicate cannot drift
+    from the single-device invariant. Batches that fit in one chunk are
+    semantically identical and pass through."""
+    if hasattr(net, "topo_order"):          # ComputationGraph: list input
+        net._reject_tbptt(xs, api)
+    else:                                   # MultiLayerNetwork: one array
+        net._reject_tbptt(xs[0], api)
+
+
+class ShardedDSLTrainerBase:
+    """Jitted donated training step over a DSL net's own loss function,
+    under caller-chosen shardings.
+
+    Subclass contract: call ``_build(net, mesh, ...)`` from ``__init__``
+    with the mode's input/mask PartitionSpecs, optional per-param
+    shardings (default: fully replicated), and an optional trace-time
+    context manager factory (entered around the loss trace, e.g. the
+    ring-attention route)."""
+
+    _api = "ShardedDSLTrainerBase"
+
+    def _build(self, net, mesh: Mesh, *, x_spec: P, mask_spec: P,
+               batch_axis: Optional[str] = None,
+               param_shardings: Optional[Pytree] = None,
+               trace_ctx=None) -> None:
+        from ..optimize import updaters as _updaters
+
+        if net.params is None:
+            net.init()
+        if batch_axis is not None and batch_axis not in mesh.axis_names:
+            raise ValueError(f"batch_axis {batch_axis!r} not in mesh "
+                             f"{mesh.axis_names}")
+        self.net = net
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._is_graph = hasattr(net, "topo_order")
+        if param_shardings is None:
+            param_shardings = NamedSharding(mesh, P())
+        net.params = jax.device_put(net.params, param_shardings)
+        if net.updater_state:
+            placed = {}
+            for slot, tree in net.updater_state.items():
+                try:
+                    placed[slot] = jax.device_put(tree, param_shardings)
+                except ValueError:
+                    # slot does not mirror the param tree: replicate it
+                    placed[slot] = jax.device_put(
+                        tree, NamedSharding(mesh, P()))
+            net.updater_state = placed
+        self._x_sharding = NamedSharding(mesh, x_spec)
+        self._mask_sharding = NamedSharding(mesh, mask_spec)
+        ctx = trace_ctx if trace_ctx is not None else contextlib.nullcontext
+
+        t = net.training
+        norm_kind = t.gradient_normalization
+        norm_thr = float(t.gradient_normalization_threshold)
+        updater = net._updater
+
+        if self._is_graph:
+            def loss_call(params, states, inputs, labels, masks, rng):
+                return net._loss_fn(params, states, inputs, labels, masks,
+                                    rng)
+        else:
+            def loss_call(params, states, inputs, labels, masks, rng):
+                return net._loss_fn(params, states, inputs[0], labels[0],
+                                    None if masks is None else masks[0],
+                                    rng)
+
+        def step(params, opt_state, states, inputs, labels, masks, rng, it):
+            with ctx():   # trace-time: bakes the mode's route into the jit
+                (loss, new_states), grads = jax.value_and_grad(
+                    loss_call, has_aux=True)(
+                        params, states, inputs, labels, masks, rng)
+            grads = _updaters.normalize_gradients(grads, norm_kind,
+                                                  norm_thr)
+            deltas, opt_state = updater.update(grads, opt_state, it)
+            params = _updaters.apply_updates(params, deltas)
+            return params, opt_state, new_states, loss
+
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+
+        if self._is_graph:
+            def fwd(params, states, inputs):
+                with ctx():
+                    acts, _ = net._forward(params, states, inputs,
+                                           train=False)
+                return [acts[n] for n in net.conf.network_outputs]
+        else:
+            def fwd(params, states, inputs):
+                with ctx():
+                    out, _ = net._forward(params, states, inputs[0],
+                                          train=False)
+                return [out]
+
+        self._fwd = jax.jit(fwd)
+
+    def _stage(self, a):
+        return jax.device_put(jnp.asarray(a), self._x_sharding)
+
+    def _stage_mask(self, m):
+        return jax.device_put(jnp.asarray(m), self._mask_sharding)
+
+    def _states(self):
+        return (self.net._states_map() if self._is_graph
+                else self.net._states_list())
+
+    def output(self, *inputs):
+        """Sharded inference over the network outputs."""
+        xs = [self._stage(x) for x in
+              (inputs[0] if len(inputs) == 1
+               and isinstance(inputs[0], (list, tuple)) else list(inputs))]
+        outs = self._fwd(self.net.params, self._states(), xs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def fit_batch(self, inputs, labels, masks=None) -> jax.Array:
+        """One sharded update on GLOBAL arrays; ``masks``: optional
+        [b, t] sequence masks."""
+        net = self.net
+        xs = [self._stage(x) for x in _as_list(inputs)]
+        _reject_tbptt_chunking(net, xs, f"{self._api}.fit_batch")
+        ys = [self._stage(y) for y in _as_list(labels)]
+        ms = (None if masks is None
+              else [None if m is None else self._stage_mask(m)
+                    for m in _as_list(masks)])
+        rng = _rng.fold_name(_rng.key(net.training.seed),
+                             f"update_{net._update_count}")
+        it = jnp.asarray(net._update_count, jnp.int32)
+        params, opt_state, new_states, loss = self._step(
+            net.params, net.updater_state, self._states(), xs, ys, ms,
+            rng, it)
+        net.params = params
+        net.updater_state = opt_state
+        net._update_count += 1
+        net._persist_states(new_states)
+        net._score = loss
+        net._fire_iteration(xs[0].shape[0], loss)
+        return loss

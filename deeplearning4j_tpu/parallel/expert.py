@@ -17,7 +17,7 @@ mean top-k assignment fraction, summed over experts and scaled by E).
 from __future__ import annotations
 
 import functools
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import rng as _rng
+from .dsl_trainer import ShardedDSLTrainerBase
 
 Pytree = Any
 
@@ -139,3 +140,75 @@ class ExpertParallelTrainer:
         self.params, loss = self._step(self.params, jnp.asarray(x),
                                        jnp.asarray(y))
         return loss
+
+
+# --------------------------------------------------------------------------
+# expert parallelism for DSL models (MoELayer vertices)
+# --------------------------------------------------------------------------
+
+
+def expert_param_specs(net, axis: str = "ep") -> Pytree:
+    """PartitionSpec pytree for net.params: expert-stacked MoELayer params
+    split over ``axis`` on their leading E dim, everything else
+    replicated."""
+    from ..nn.conf.moe import MoELayer
+
+    def layer_of(key):
+        if hasattr(net, "topo_order"):
+            v = net.conf.vertices.get(key)
+            return getattr(v, "layer", None)
+        idx = int(key.split("_")[-1])
+        return net.layers[idx]
+
+    specs = {}
+    for key, lp in net.params.items():
+        layer = layer_of(key)
+        if isinstance(layer, MoELayer):
+            specs[key] = {
+                name: (P(axis, *([None] * (p.ndim - 1)))
+                       if name != "router" else P())
+                for name, p in lp.items()}
+        else:
+            specs[key] = {name: P() for name in lp}
+    return specs
+
+
+class ExpertParallelGraphTrainer(ShardedDSLTrainerBase):
+    """Expert-parallel training for DSL models containing ``MoELayer``s:
+    expert-stacked params are sharded over the ``ep`` mesh axis (each
+    device holds E/ep experts; XLA partitions the dense-dispatch einsums
+    and inserts the cross-expert reduce), everything else replicated,
+    batch optionally data-parallel over ``batch_axis``. Shares the full
+    sharded-trainer contract (masks, TBPTT chunk rejection, output())
+    with ``SequenceParallelGraphTrainer`` via ``ShardedDSLTrainerBase``.
+    """
+
+    _api = "ExpertParallelGraphTrainer"
+
+    def __init__(self, net, mesh: Mesh, *, axis: str = "ep",
+                 batch_axis: Optional[str] = None):
+        if net.params is None:
+            net.init()
+        if axis not in mesh.axis_names:
+            raise ValueError(f"expert axis {axis!r} not in mesh "
+                             f"{mesh.axis_names}")
+        self.axis = axis
+        specs = expert_param_specs(net, axis)
+        if not any(s != P() for lp in specs.values() for s in lp.values()):
+            raise ValueError("no MoELayer params found to shard — "
+                             "ExpertParallelGraphTrainer needs MoE "
+                             "vertices")
+        n_exp = {tuple(p.shape)[0] for key, lp in net.params.items()
+                 for name, p in lp.items()
+                 if specs[key][name] != P() and name != "router"}
+        for e in n_exp:
+            if e % mesh.shape[axis]:
+                raise ValueError(
+                    f"n_experts={e} not divisible by mesh axis "
+                    f"{axis!r} size {mesh.shape[axis]}")
+        shardings = jax.tree_util.tree_map(
+            lambda sp: NamedSharding(mesh, sp), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        self._build(net, mesh,
+                    x_spec=P(batch_axis), mask_spec=P(batch_axis),
+                    batch_axis=batch_axis, param_shardings=shardings)

@@ -25,6 +25,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import rng as _rng
 from ..ops.attention import dot_product_attention, make_ring_attention
+from .dsl_trainer import (ShardedDSLTrainerBase, _as_list,
+                          _reject_tbptt_chunking)  # noqa: F401  (pipeline
+#                                                   imports the helpers here)
 
 Pytree = Any
 
@@ -127,7 +130,7 @@ def dense_attention_fn(q, k, v):
     return dot_product_attention(q, k, v, causal=True)
 
 
-class SequenceParallelGraphTrainer:
+class SequenceParallelGraphTrainer(ShardedDSLTrainerBase):
     """Sequence-parallel training for ANY DSL model (``ComputationGraph``
     or ``MultiLayerNetwork``) whose vertices are time-axis-preserving —
     e.g. ``models.transformer.transformer_lm``.
@@ -139,142 +142,30 @@ class SequenceParallelGraphTrainer:
     mixes timesteps — ``SelfAttentionLayer`` — is routed to ring attention
     by tracing the network's OWN loss function inside an
     ``ops.attention.sequence_sharding`` context. One jitted donated step;
-    the backward differentiates through the ring's ppermute.
+    the backward differentiates through the ring's ppermute. Sequence
+    masks ([b, t], sharded over batch x seq) ride the ring with their
+    K/V shards.
 
     Reference bar: the reference's distributed paths serve arbitrary user
     nets (``ParallelWrapper.java:37``, ``TrainingMaster.java:29``); this
     brings sequence parallelism to the same contract.
     """
 
+    _api = "SequenceParallelGraphTrainer"
+
     def __init__(self, net, mesh: Mesh, *, seq_axis: str = "seq",
                  batch_axis: Optional[str] = None):
-        from ..optimize import updaters as _updaters
         from ..ops.attention import sequence_sharding
 
-        if net.params is None:
-            net.init()
-        if batch_axis is not None and batch_axis not in mesh.axis_names:
-            raise ValueError(f"batch_axis {batch_axis!r} not in mesh "
-                             f"{mesh.axis_names}")
         if seq_axis not in mesh.axis_names:
             raise ValueError(f"seq_axis {seq_axis!r} not in mesh "
                              f"{mesh.axis_names}")
-        self.net = net
-        self.mesh = mesh
         self.seq_axis = seq_axis
-        self.batch_axis = batch_axis
-        self._is_graph = hasattr(net, "topo_order")
-
-        repl = NamedSharding(mesh, P())
-        net.params = jax.device_put(net.params, repl)
-        if net.updater_state:
-            net.updater_state = jax.device_put(net.updater_state, repl)
-        self._x_sharding = NamedSharding(mesh, P(batch_axis, seq_axis, None))
-
-        t = net.training
-        norm_kind = t.gradient_normalization
-        norm_thr = float(t.gradient_normalization_threshold)
-        updater = net._updater
-        ctx = lambda: sequence_sharding(mesh, seq_axis, batch_axis)
-
-        if self._is_graph:
-            def loss_call(params, states, inputs, labels, masks, rng):
-                return net._loss_fn(params, states, inputs, labels, masks,
-                                    rng)
-        else:
-            def loss_call(params, states, inputs, labels, masks, rng):
-                return net._loss_fn(params, states, inputs[0], labels[0],
-                                    None if masks is None else masks[0],
-                                    rng)
-
-        def step(params, opt_state, states, inputs, labels, masks, rng, it):
-            with ctx():   # trace-time: bakes the ring route into the jit
-                (loss, new_states), grads = jax.value_and_grad(
-                    loss_call, has_aux=True)(
-                        params, states, inputs, labels, masks, rng)
-            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
-            deltas, opt_state = updater.update(grads, opt_state, it)
-            params = _updaters.apply_updates(params, deltas)
-            return params, opt_state, new_states, loss
-
-        self._step = jax.jit(step, donate_argnums=(0, 1))
-
-        if self._is_graph:
-            def fwd(params, states, inputs):
-                with ctx():
-                    acts, _ = net._forward(params, states, inputs,
-                                           train=False)
-                return [acts[n] for n in net.conf.network_outputs]
-        else:
-            def fwd(params, states, inputs):
-                with ctx():
-                    out, _ = net._forward(params, states, inputs[0],
-                                          train=False)
-                return [out]
-
-        self._fwd = jax.jit(fwd)
-
-    def _stage(self, a):
-        return jax.device_put(jnp.asarray(a), self._x_sharding)
-
-    def _states(self):
-        return (self.net._states_map() if self._is_graph
-                else self.net._states_list())
-
-    def output(self, *inputs):
-        """Sequence-sharded inference over the network outputs."""
-        xs = [self._stage(x) for x in
-              (inputs[0] if len(inputs) == 1
-               and isinstance(inputs[0], (list, tuple)) else list(inputs))]
-        outs = self._fwd(self.net.params, self._states(), xs)
-        return outs[0] if len(outs) == 1 else outs
-
-    def _stage_mask(self, m):
-        sh = NamedSharding(self.mesh, P(self.batch_axis, self.seq_axis))
-        return jax.device_put(jnp.asarray(m), sh)
-
-    def fit_batch(self, inputs, labels, masks=None) -> jax.Array:
-        """One sequence-parallel update on GLOBAL [b, t, f] arrays (t
-        divisible by the seq mesh axis; b by the batch axis if 2-D).
-        ``masks``: optional [b, t] sequence masks — mask shards rotate
-        around the attention ring with their K/V shards."""
-        net = self.net
-        xs = [self._stage(x) for x in _as_list(inputs)]
-        _reject_tbptt_chunking(net, xs,
-                               "SequenceParallelGraphTrainer.fit_batch")
-        ys = [self._stage(y) for y in _as_list(labels)]
-        ms = (None if masks is None
-              else [None if m is None else self._stage_mask(m)
-                    for m in _as_list(masks)])
-        rng = _rng.fold_name(_rng.key(net.training.seed),
-                             f"update_{net._update_count}")
-        it = jnp.asarray(net._update_count, jnp.int32)
-        params, opt_state, new_states, loss = self._step(
-            net.params, net.updater_state, self._states(), xs, ys, ms,
-            rng, it)
-        net.params = params
-        net.updater_state = opt_state
-        net._update_count += 1
-        net._persist_states(new_states)
-        net._score = loss
-        net._fire_iteration(xs[0].shape[0], loss)
-        return loss
+        self._build(net, mesh,
+                    x_spec=P(batch_axis, seq_axis, None),
+                    mask_spec=P(batch_axis, seq_axis),
+                    batch_axis=batch_axis,
+                    trace_ctx=lambda: sequence_sharding(mesh, seq_axis,
+                                                        batch_axis))
 
 
-def _as_list(v):
-    return list(v) if isinstance(v, (list, tuple)) else [v]
-
-
-def _reject_tbptt_chunking(net, xs, api: str) -> None:
-    """The sharded trainers run ONE full-sequence BPTT update per batch;
-    silently doing that where the single-device path would chunk
-    (truncated_bptt with T > tbptt_fwd_length) changes optimization
-    semantics — refuse loudly. Delegates to the net's OWN
-    ``_reject_tbptt`` (graph nets scan ALL inputs for the temporal axis;
-    a first input may be static [b, f]) so the predicate cannot drift
-    from the single-device invariant. Batches that fit in one chunk are
-    semantically identical and pass through."""
-    if hasattr(net, "topo_order"):          # ComputationGraph: list input
-        net._reject_tbptt(xs, api)
-    else:                                   # MultiLayerNetwork: one array
-        net._reject_tbptt(xs[0], api)

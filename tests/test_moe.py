@@ -1,0 +1,207 @@
+"""MoELayer (DSL mixture-of-experts) + expert-parallel training tests.
+
+North-star EP as a config-DSL capability (the VERDICT row-67 bar): the
+layer lives in ordinary networks (serde, aux-loss-in-training, gradient
+check), and ExpertParallelGraphTrainer shards the expert dim of a real
+DSL transformer with single-device parity. Runs on the 8-device virtual
+CPU mesh (conftest).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import transformer_lm
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.moe import MoELayer
+from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import (ExpertParallelGraphTrainer,
+                                         create_mesh)
+
+V, T, B = 11, 8, 4
+
+
+def _moe_net(updater="sgd", lr=0.05, experts=8, top_k=2):
+    return ComputationGraph(transformer_lm(
+        V, n_layers=2, d_model=16, n_heads=2, d_ff=32, updater=updater,
+        learning_rate=lr, seed=5, moe_experts=experts,
+        moe_top_k=top_k)).init()
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, V, (B, T + 1))
+    eye = np.eye(V, dtype=np.float32)
+    return eye[ids[:, :-1]], eye[ids[:, 1:]]
+
+
+class TestMoELayer:
+    def test_exact_topk_experts_fire(self, rng):
+        layer = MoELayer(n_in=8, d_hidden=16, n_experts=4, top_k=2)
+        layer.set_n_in(InputType.recurrent(8, 4))
+        params = layer.init_params(jax.random.key(0))
+        x = jnp.asarray(rng.normal(size=(2, 4, 8)).astype(np.float32))
+        y, st = layer.apply(params, x)
+        assert y.shape == (2, 4, 8)
+        assert np.isfinite(float(st["aux_loss"]))
+
+    def test_2d_and_3d_agree(self, rng):
+        """[b, f] applies as a single-timestep [b, 1, f]."""
+        layer = MoELayer(n_in=8, d_hidden=16, n_experts=4, top_k=2)
+        layer.set_n_in(InputType.feed_forward(8))
+        params = layer.init_params(jax.random.key(0))
+        x = jnp.asarray(rng.normal(size=(6, 8)).astype(np.float32))
+        y2, _ = layer.apply(params, x)
+        y3, _ = layer.apply(params, x[:, None, :])
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y3[:, 0]),
+                                   atol=1e-6)
+
+    def test_mask_zeroes_and_excludes_from_aux(self, rng):
+        layer = MoELayer(n_in=8, d_hidden=16, n_experts=4, top_k=2)
+        layer.set_n_in(InputType.recurrent(8, 4))
+        params = layer.init_params(jax.random.key(0))
+        x = jnp.asarray(rng.normal(size=(2, 4, 8)).astype(np.float32))
+        mask = jnp.asarray([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+        y, st = layer.apply(params, x, mask=mask)
+        assert np.allclose(np.asarray(y)[0, 2:], 0.0)
+        # aux from the valid prefix only: changing a masked step's input
+        # must not change aux
+        x2 = x.at[0, 3].set(100.0)
+        _, st2 = layer.apply(params, x2, mask=mask)
+        assert float(st["aux_loss"]) == pytest.approx(
+            float(st2["aux_loss"]), rel=1e-6)
+
+    def test_gradient_check_dense_gating(self, rng):
+        """top_k == n_experts (no discrete routing): exact f64 central
+        difference through the full layer, aux loss included."""
+        layer = MoELayer(n_in=4, d_hidden=8, n_experts=3, top_k=3)
+        layer.set_n_in(InputType.recurrent(4, 3))
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float64),
+            layer.init_params(jax.random.key(1)))
+        x = jnp.asarray(rng.normal(size=(2, 3, 4)))
+
+        from deeplearning4j_tpu import dtypes as _dtypes
+        f64 = _dtypes.policy_from_name("float64")
+
+        def loss(p):
+            y, st = layer.apply(p, x, policy=f64)
+            return jnp.sum(y ** 2) + st["aux_loss"]
+
+        g = jax.grad(loss)(params)
+        eps = 1e-6
+        flat, tree = jax.tree_util.tree_flatten(params)
+        gflat = jax.tree_util.tree_leaves(g)
+        for li, (leaf, gleaf) in enumerate(zip(flat, gflat)):
+            idx = tuple(0 for _ in leaf.shape)
+            bump = jnp.zeros_like(leaf).at[idx].set(eps)
+            lp = jax.tree_util.tree_unflatten(
+                tree, [l + (bump if i == li else 0) for i, l in
+                       enumerate(flat)])
+            lm = jax.tree_util.tree_unflatten(
+                tree, [l - (bump if i == li else 0) for i, l in
+                       enumerate(flat)])
+            fd = (loss(lp) - loss(lm)) / (2 * eps)
+            assert float(gleaf[idx]) == pytest.approx(float(fd), rel=1e-4,
+                                                      abs=1e-7)
+
+    def test_serde_roundtrip(self):
+        conf = transformer_lm(V, n_layers=1, d_model=16, n_heads=2,
+                              d_ff=32, moe_experts=4, moe_top_k=2)
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        l2 = conf2.vertices["blk0_moe"].layer
+        assert isinstance(l2, MoELayer)
+        assert (l2.n_experts, l2.top_k, l2.d_hidden) == (4, 2, 32)
+
+
+class TestMoETraining:
+    def test_single_device_training_includes_aux(self):
+        """The load-balancing aux loss reaches the objective: zeroing
+        aux_weight changes the training loss."""
+        net_a = _moe_net()
+        conf_b = transformer_lm(V, n_layers=2, d_model=16, n_heads=2,
+                                d_ff=32, updater="sgd", learning_rate=0.05,
+                                seed=5, moe_experts=8)
+        for v in conf_b.vertices.values():
+            if getattr(v, "layer", None) is not None \
+                    and isinstance(v.layer, MoELayer):
+                v.layer.aux_weight = 0.0
+        net_b = ComputationGraph(conf_b).init()
+        x, y = _data()
+        la = float(net_a.fit_batch([x], [y]))
+        lb = float(net_b.fit_batch([x], [y]))
+        assert la > lb  # aux > 0 always (E * sum gate*keep >= 1)
+
+    def test_moe_transformer_trains(self):
+        net = _moe_net(updater="adam", lr=1e-2)
+        x, y = _data()
+        l0 = float(net.fit_batch([x], [y]))
+        for _ in range(10):
+            l = float(net.fit_batch([x], [y]))
+        assert l < l0
+
+    def test_multilayer_aux_loss_wired(self, rng):
+        """MoELayer in the sequential DSL: the MLN loss also adds
+        aux_loss state entries."""
+        def mk(aux_w):
+            return MultiLayerNetwork(
+                (NeuralNetConfiguration.builder().seed(2).updater("sgd")
+                 .learning_rate(0.05).list()
+                 .layer(MoELayer(d_hidden=16, n_experts=4, top_k=2,
+                                 aux_weight=aux_w))
+                 .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                       loss="mcxent"))
+                 .set_input_type(InputType.recurrent(V)).build())).init()
+        x, y = _data()
+        la = mk(0.01).score_for(x, y)
+        lb = mk(0.0).score_for(x, y)
+        assert la > lb
+
+
+class TestExpertParallelDSL:
+    def test_ep_matches_single_device(self):
+        net_ep, net_ref = _moe_net(), _moe_net()
+        x, y = _data()
+        ep = ExpertParallelGraphTrainer(net_ep, create_mesh({"ep": 8}))
+        for _ in range(3):
+            l_ep = float(ep.fit_batch(x, y))
+            l_ref = float(net_ref.fit_batch([x], [y]))
+            assert l_ep == pytest.approx(l_ref, abs=1e-4)
+
+    def test_expert_params_actually_sharded(self):
+        net = _moe_net()
+        ep = ExpertParallelGraphTrainer(net, create_mesh({"ep": 8}))
+        w1 = net.params["blk0_moe"]["w1"]
+        assert w1.sharding.spec[0] == "ep"
+        shard = next(iter(w1.addressable_shards))
+        assert shard.data.shape[0] == w1.shape[0] // 8  # 1 expert/device
+        # router stays replicated
+        assert net.params["blk0_moe"]["router"].sharding.spec \
+            == jax.sharding.PartitionSpec()
+
+    def test_dp_ep_composed(self):
+        net_ep, net_ref = _moe_net(), _moe_net()
+        x, y = _data()
+        ep = ExpertParallelGraphTrainer(
+            net_ep, create_mesh({"dp": 2, "ep": 4}), batch_axis="dp")
+        for _ in range(2):
+            l_ep = float(ep.fit_batch(x, y))
+            l_ref = float(net_ref.fit_batch([x], [y]))
+            assert l_ep == pytest.approx(l_ref, abs=1e-4)
+
+    def test_no_moe_vertices_raises(self):
+        net = ComputationGraph(transformer_lm(
+            V, n_layers=1, d_model=16, n_heads=2, d_ff=32)).init()
+        with pytest.raises(ValueError, match="no MoELayer"):
+            ExpertParallelGraphTrainer(net, create_mesh({"ep": 8}))
+
+    def test_indivisible_experts_raise(self):
+        net = _moe_net(experts=6)
+        with pytest.raises(ValueError, match="not divisible"):
+            ExpertParallelGraphTrainer(net, create_mesh({"ep": 8}))
