@@ -173,6 +173,32 @@ def expert_param_specs(net, axis: str = "ep") -> Pytree:
     return specs
 
 
+
+def expert_param_shardings(net, mesh: Mesh, axis: str = "ep") -> Pytree:
+    """Validated NamedSharding tree for a net's MoELayer expert params:
+    raises if the net has no MoE vertices or an expert count does not
+    divide the mesh axis. ONE implementation for every trainer that
+    composes expert sharding."""
+    if axis not in mesh.axis_names:
+        raise ValueError(f"expert axis {axis!r} not in mesh "
+                         f"{mesh.axis_names}")
+    specs = expert_param_specs(net, axis)
+    if not any(sp != P() for lp in specs.values() for sp in lp.values()):
+        raise ValueError("no MoELayer params found to shard — expert "
+                         "parallelism needs MoE vertices in the net")
+    n_exp = {tuple(p.shape)[0] for key, lp in net.params.items()
+             for name, p in lp.items()
+             if specs[key][name] != P() and name != "router"}
+    for e in n_exp:
+        if e % mesh.shape[axis]:
+            raise ValueError(
+                f"n_experts={e} not divisible by mesh axis "
+                f"{axis!r} size {mesh.shape[axis]}")
+    return jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
 class ExpertParallelGraphTrainer(ShardedDSLTrainerBase):
     """Expert-parallel training for DSL models containing ``MoELayer``s:
     expert-stacked params are sharded over the ``ep`` mesh axis (each
@@ -189,26 +215,8 @@ class ExpertParallelGraphTrainer(ShardedDSLTrainerBase):
                  batch_axis: Optional[str] = None):
         if net.params is None:
             net.init()
-        if axis not in mesh.axis_names:
-            raise ValueError(f"expert axis {axis!r} not in mesh "
-                             f"{mesh.axis_names}")
         self.axis = axis
-        specs = expert_param_specs(net, axis)
-        if not any(s != P() for lp in specs.values() for s in lp.values()):
-            raise ValueError("no MoELayer params found to shard — "
-                             "ExpertParallelGraphTrainer needs MoE "
-                             "vertices")
-        n_exp = {tuple(p.shape)[0] for key, lp in net.params.items()
-                 for name, p in lp.items()
-                 if specs[key][name] != P() and name != "router"}
-        for e in n_exp:
-            if e % mesh.shape[axis]:
-                raise ValueError(
-                    f"n_experts={e} not divisible by mesh axis "
-                    f"{axis!r} size {mesh.shape[axis]}")
-        shardings = jax.tree_util.tree_map(
-            lambda sp: NamedSharding(mesh, sp), specs,
-            is_leaf=lambda x: isinstance(x, P))
+        shardings = expert_param_shardings(net, mesh, axis)
         self._build(net, mesh,
                     x_spec=P(batch_axis), mask_spec=P(batch_axis),
                     batch_axis=batch_axis, param_shardings=shardings)

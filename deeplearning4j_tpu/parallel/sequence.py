@@ -146,6 +146,10 @@ class SequenceParallelGraphTrainer(ShardedDSLTrainerBase):
     masks ([b, t], sharded over batch x seq) ride the ring with their
     K/V shards.
 
+    ``expert_axis``: optional mesh axis for sp × ep composition — MoELayer
+    expert-stacked params shard over it (``parallel.expert``'s specs)
+    while the time axis rides the ring, in the same jitted step.
+
     Reference bar: the reference's distributed paths serve arbitrary user
     nets (``ParallelWrapper.java:37``, ``TrainingMaster.java:29``); this
     brings sequence parallelism to the same contract.
@@ -154,17 +158,26 @@ class SequenceParallelGraphTrainer(ShardedDSLTrainerBase):
     _api = "SequenceParallelGraphTrainer"
 
     def __init__(self, net, mesh: Mesh, *, seq_axis: str = "seq",
-                 batch_axis: Optional[str] = None):
+                 batch_axis: Optional[str] = None,
+                 expert_axis: Optional[str] = None):
         from ..ops.attention import sequence_sharding
 
         if seq_axis not in mesh.axis_names:
             raise ValueError(f"seq_axis {seq_axis!r} not in mesh "
                              f"{mesh.axis_names}")
         self.seq_axis = seq_axis
+        param_shardings = None
+        if expert_axis is not None:
+            from .expert import expert_param_shardings
+            if net.params is None:
+                net.init()
+            param_shardings = expert_param_shardings(net, mesh,
+                                                     expert_axis)
         self._build(net, mesh,
                     x_spec=P(batch_axis, seq_axis, None),
                     mask_spec=P(batch_axis, seq_axis),
                     batch_axis=batch_axis,
+                    param_shardings=param_shardings,
                     trace_ctx=lambda: sequence_sharding(mesh, seq_axis,
                                                         batch_axis))
 

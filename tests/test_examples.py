@@ -16,11 +16,22 @@ _EXAMPLES = sorted(
     if f.endswith(".py"))
 
 
+# XLA's in-process CPU collectives abort if any participant thread is
+# starved >40 s (rendezvous.cc hard deadline, no flag). This harness has
+# ONE core: an 8-thread per-step-psum rendezvous under cgroup scheduling
+# jitter trips it (seen deterministically mid-suite for the dp example).
+# The dp math is identical at any mesh size, so the heavy-collective
+# example runs its smoke test on 2 virtual devices; everything else keeps
+# the suite-standard 8.
+_DEVICE_COUNT = {"data_parallel_training.py": 2}
+
+
 @pytest.mark.parametrize("script", _EXAMPLES)
 def test_example_smoke(script):
+    n_dev = _DEVICE_COUNT.get(script, 8)
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count={n_dev}",
                PYTHONPATH=_REPO)
     first = None
     for attempt in (1, 2):
@@ -34,7 +45,8 @@ def test_example_smoke(script):
         # one retry for ANY failure: on this harness the subprocess's jax
         # preload can transiently lose a race for the device tunnel while
         # other tests/benches hold it (also covers OOM signal kills)
-        first = f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
+        if first is None:   # keep attempt 1's diagnostics distinct
+            first = f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
     if proc.returncode == 0 and first is not None:
         # a pass that NEEDED its retry must be loud, not silent: a real
         # intermittent bug hiding as "tunnel flake" shows up here as this
