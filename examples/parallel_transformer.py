@@ -1,0 +1,80 @@
+"""One DSL transformer, four parallelism modes.
+
+The same ``models.transformer.transformer_lm`` ComputationGraph trains:
+  1. sequence-parallel   — time axis ring-sharded over `seq`
+  2. pipeline-parallel   — blocks 1/S-sharded over `pp` (GPipe schedule)
+  3. expert-parallel     — MoE variant, expert dims sharded over `ep`
+  4. composed dp x seq   — 2-D mesh, one jitted step
+
+All four produce the SAME numbers as the single-device run (that's the
+contract the tests pin); this example just shows the API shapes. Run on
+any multi-device platform, or simulate one:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/parallel_transformer.py --smoke
+"""
+
+import sys
+
+import numpy as np
+
+
+def batch(vocab, b, t, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (b, t + 1))
+    eye = np.eye(vocab, dtype=np.float32)
+    return eye[ids[:, :-1]], eye[ids[:, 1:]]
+
+
+def main(smoke: bool = False):
+    import jax
+    from deeplearning4j_tpu.models import transformer_lm
+    from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+    from deeplearning4j_tpu.parallel import (ExpertParallelGraphTrainer,
+                                             GraphPipelineTrainer,
+                                             SequenceParallelGraphTrainer,
+                                             create_mesh)
+
+    n = jax.device_count()
+    if n < 2:
+        print("need >1 device — simulate with XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu")
+        return
+    V, T, B = 11, 2 * n, 4
+    steps = 3 if smoke else 30
+
+    def tlm(**kw):
+        return ComputationGraph(transformer_lm(
+            V, d_model=16, n_heads=2, d_ff=32, updater="adam",
+            learning_rate=1e-2, seed=7, **kw)).init()
+
+    x, y = batch(V, B, T)
+
+    sp = SequenceParallelGraphTrainer(tlm(n_layers=2),
+                                      create_mesh({"seq": n}))
+    losses = [float(sp.fit_batch(x, y)) for _ in range(steps)]
+    print(f"sequence-parallel ({n} devs): {losses[0]:.3f} -> "
+          f"{losses[-1]:.3f}")
+
+    pp = GraphPipelineTrainer(tlm(n_layers=n), create_mesh({"pp": n}),
+                              n_micro=2)
+    losses = [float(pp.fit_batch(x, y)) for _ in range(steps)]
+    print(f"pipeline-parallel ({n} stages): {losses[0]:.3f} -> "
+          f"{losses[-1]:.3f}")
+
+    ep = ExpertParallelGraphTrainer(
+        tlm(n_layers=2, moe_experts=2 * n), create_mesh({"ep": n}))
+    losses = [float(ep.fit_batch(x, y)) for _ in range(steps)]
+    print(f"expert-parallel ({2 * n} experts / {n} devs): "
+          f"{losses[0]:.3f} -> {losses[-1]:.3f}")
+
+    if n % 2 == 0 and n >= 4:
+        sp2 = SequenceParallelGraphTrainer(
+            tlm(n_layers=2), create_mesh({"dp": 2, "seq": n // 2}),
+            batch_axis="dp")
+        losses = [float(sp2.fit_batch(x, y)) for _ in range(steps)]
+        print(f"dp x seq 2-D mesh: {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

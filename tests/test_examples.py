@@ -20,10 +20,13 @@ _EXAMPLES = sorted(
 # starved >40 s (rendezvous.cc hard deadline, no flag). This harness has
 # ONE core: an 8-thread per-step-psum rendezvous under cgroup scheduling
 # jitter trips it (seen deterministically mid-suite for the dp example).
-# The dp math is identical at any mesh size, so the heavy-collective
-# example runs its smoke test on 2 virtual devices; everything else keeps
-# the suite-standard 8.
-_DEVICE_COUNT = {"data_parallel_training.py": 2}
+# The parallel math is identical at any mesh size, so the heavy-collective
+# examples run their smoke tests on reduced meshes (2 for the per-step-psum
+# dp example, 4 for the multi-mode parallel transformer — the smallest
+# count that still exercises its composed 2-D branch); everything else
+# keeps the suite-standard 8.
+_DEVICE_COUNT = {"data_parallel_training.py": 2,
+                 "parallel_transformer.py": 4}
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)
