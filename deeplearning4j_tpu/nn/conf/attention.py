@@ -41,6 +41,14 @@ class SelfAttentionLayer(Layer):
     n_out: Optional[int] = None       # defaults to n_in
     n_heads: int = 4
     causal: bool = True
+    # streaming decode: K/V cache length for rnn_time_step. None = no
+    # cache — rnn_time_step then attends WITHIN each fed chunk only (no
+    # history), which is almost never what you want for attention; set
+    # max_cache_t for true incremental decode. Feeding more than
+    # max_cache_t TOTAL steps silently clamps (the tail overwrites) —
+    # reset with rnn_clear_previous_state() between sequences. Causal
+    # layers only.
+    max_cache_t: Optional[int] = None
 
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.n_out or self.n_in,
@@ -93,6 +101,76 @@ class SelfAttentionLayer(Layer):
                 "b": jnp.full((self.n_out,), float(self.bias_init or 0.0),
                               dt)}
 
+    def _zero_state(self, batch, policy):
+        """Streaming K/V cache (only when ``max_cache_t`` is set): rides
+        the same h/c carry machinery as the recurrent layers —
+        ``h``/``c`` are the [b, max_t+1, n_in] K/V caches whose LAST row
+        smuggles the write position (the carry contract is h/c-shaped,
+        so the counter lives in-band)."""
+        if self.max_cache_t is None:
+            raise ValueError(
+                "SelfAttentionLayer streaming needs max_cache_t set")
+        if not self.causal:
+            raise ValueError(
+                "SelfAttentionLayer streaming decode requires causal=True "
+                "(incremental decode of bidirectional attention is "
+                "ill-defined — later tokens would change earlier outputs)")
+        # at least f32: the in-band position counter must count exactly
+        # (bf16 rounds integers past 256), and cached K/V precision
+        # benefits too
+        dt = jnp.promote_types(policy.compute_dtype, jnp.float32)
+        shape = (batch, self.max_cache_t + 1, self.n_in)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def _apply_streaming(self, params, xc, state, policy):
+        """Incremental decode: append this chunk's K/V to the cache and
+        attend the new queries over everything cached so far (causal
+        across calls). O(t_new · cached) instead of O(T²) per token."""
+        b, t_new, f = xc.shape
+        h = self.n_heads
+        max_t = self.max_cache_t
+        if t_new > max_t:   # shapes are static: fail at trace, not silently
+            raise ValueError(
+                f"streaming chunk of {t_new} steps exceeds "
+                f"max_cache_t={max_t}; raise max_cache_t or feed smaller "
+                "chunks")
+        wqkv = params["Wqkv"].astype(xc.dtype)
+        qkv = (xc @ wqkv).reshape(b, t_new, 3, h, f // h)
+        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_cache, v_cache = state["h"], state["c"]
+        pos = k_cache[0, -1, 0].astype(jnp.int32)
+        pos = jnp.minimum(pos, max_t - t_new)   # clamp (documented)
+        k_flat = k_new.reshape(b, t_new, f).astype(k_cache.dtype)
+        v_flat = v_new.reshape(b, t_new, f).astype(v_cache.dtype)
+        zero = jnp.zeros((), pos.dtype)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k_flat,
+                                               (zero, pos, zero))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v_flat,
+                                               (zero, pos, zero))
+        kh = k_cache[:, :max_t].reshape(b, max_t, h, f // h)
+        vh = v_cache[:, :max_t].reshape(b, max_t, h, f // h)
+        scale = 1.0 / jnp.sqrt(f // h).astype(xc.dtype)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kh) * scale
+        # new query i sits at global position pos+i: attend keys <= pos+i
+        key_idx = jnp.arange(max_t)
+        q_idx = pos + jnp.arange(t_new)
+        allow = key_idx[None, :] <= q_idx[:, None]          # [t_new, max_t]
+        logits = jnp.where(allow[None, None], logits.astype(jnp.float32),
+                           -jnp.inf)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+        p = jnp.where(jnp.isneginf(logits), 0.0, jnp.exp(logits - m_safe))
+        weights = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True),
+                                  1e-30)
+        att = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(xc.dtype), vh)
+        wo = params["Wo"].astype(att.dtype)
+        out = att.reshape(b, t_new, f) @ wo + params["b"].astype(att.dtype)
+        out = self._act(self.activation or "identity")(out)
+        new_pos = (pos + t_new).astype(k_cache.dtype)
+        k_cache = k_cache.at[:, -1, 0].set(new_pos)
+        v_cache = v_cache.at[:, -1, 0].set(new_pos)
+        return out, {"h": k_cache, "c": v_cache}
+
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None, policy=None):
         from ...ops.attention import (active_sequence_sharding,
@@ -101,6 +179,10 @@ class SelfAttentionLayer(Layer):
         policy = policy or _dtypes.default_policy()
         x = self._dropout_in(x, train, rng)
         xc, wqkv = policy.cast_to_compute(x, params["Wqkv"])
+        if (not train and mask is None and self.max_cache_t is not None
+                and state is not None and "h" in state):
+            # streaming decode with the carried K/V cache (rnn_time_step)
+            return self._apply_streaming(params, xc, state, policy)
         b, t, f = xc.shape
         h = self.n_heads
         qkv = (xc @ wqkv).reshape(b, t, 3, h, f // h)

@@ -313,6 +313,12 @@ class ComputationGraph:
         squeeze = inputs[0].ndim == 2
         if squeeze:
             inputs = [x[:, None, :] for x in inputs]
+        if self._rnn_state is None:
+            # seed the streaming carries (LSTM h/c zeros; attention K/V
+            # caches when max_cache_t is set) — apply() distinguishes a
+            # streaming call from plain output() by the presence of the
+            # carried cache
+            self._rnn_state = self._zero_rnn_carry(inputs[0].shape[0])
         fn = self._jit_cache.get("rnn_time_step")
         if fn is None:
             @jax.jit
@@ -766,7 +772,10 @@ class ComputationGraph:
         carry = {}
         for name in self.topo_order:
             layer = self._vertex_layer(name)
-            if layer is not None and hasattr(layer, "_zero_state"):
+            # max_cache_t None = a streaming-capable layer (attention)
+            # whose cache is disabled — it carries nothing
+            if (layer is not None and hasattr(layer, "_zero_state")
+                    and getattr(layer, "max_cache_t", True) is not None):
                 mb = mbs[self.conf.vertex_inputs[name][0]]
                 h, c = layer._zero_state(mb, self.policy)
                 carry[name] = {"h": h, "c": c}

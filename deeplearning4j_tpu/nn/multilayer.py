@@ -273,6 +273,12 @@ class MultiLayerNetwork:
         squeeze = x.ndim == 2
         if squeeze:
             x = x[:, None, :]
+        if self._rnn_state is None:
+            # seed the streaming carries (LSTM h/c zeros; attention K/V
+            # caches when max_cache_t is set) — apply() distinguishes a
+            # streaming call from plain output() by the presence of the
+            # carried cache
+            self._rnn_state = self._zero_rnn_carry(x.shape[0])
         fn = self._jit_cache.get("rnn_time_step")
         if fn is None:
             @jax.jit
@@ -633,7 +639,10 @@ class MultiLayerNetwork:
     def _zero_rnn_carry(self, batch):
         carry = []
         for layer in self.layers:
-            if hasattr(layer, "_zero_state"):
+            # max_cache_t None = a streaming-capable layer (attention)
+            # whose cache is disabled — it carries nothing
+            if (hasattr(layer, "_zero_state")
+                    and getattr(layer, "max_cache_t", True) is not None):
                 h, c = layer._zero_state(batch, self.policy)
                 carry.append({"h": h, "c": c})
             else:

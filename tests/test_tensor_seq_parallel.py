@@ -259,3 +259,111 @@ class TestSequenceParallelTraining:
         x = rng.normal(size=(2, 4, 5)).astype(np.float32)
         y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 4))]
         assert np.isfinite(float(net.fit_batch(x, y)))
+
+
+class TestStreamingAttentionDecode:
+    """KV-cache incremental decode: rnn_time_step on an attention stack
+    (max_cache_t set) reproduces the full causal forward token by token —
+    the transformer analog of the reference's rnnTimeStep contract."""
+
+    def _mln(self, max_cache_t):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (LayerNormalization,
+                                                       RnnOutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().seed(3).updater("sgd")
+             .learning_rate(0.1).list()
+             .layer(LayerNormalization())
+             .layer(SelfAttentionLayer(n_in=8, n_out=8, n_heads=2,
+                                       causal=True,
+                                       max_cache_t=max_cache_t))
+             .layer(RnnOutputLayer(n_out=5, activation="softmax",
+                                   loss="mcxent"))
+             .set_input_type(InputType.recurrent(8)).build())).init()
+
+    def test_token_by_token_matches_full_forward(self, rng):
+        T = 6
+        net = self._mln(max_cache_t=16)
+        x = rng.normal(size=(2, T, 8)).astype(np.float32)
+        full = np.asarray(net.output(x))                  # [b, T, 5]
+        steps = [np.asarray(net.rnn_time_step(x[:, i]))   # [b, 5] each
+                 for i in range(T)]
+        for i, s in enumerate(steps):
+            np.testing.assert_allclose(s, full[:, i], rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_chunked_streaming_matches_full(self, rng):
+        """Multi-step chunks also stream correctly (prefill + decode)."""
+        net = self._mln(max_cache_t=16)
+        x = rng.normal(size=(2, 8, 8)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        first = np.asarray(net.rnn_time_step(x[:, :5]))   # prefill 5
+        rest = np.asarray(net.rnn_time_step(x[:, 5:]))    # decode 3
+        np.testing.assert_allclose(first, full[:, :5], rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(rest, full[:, 5:], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_clear_resets_the_cache(self, rng):
+        net = self._mln(max_cache_t=16)
+        x = rng.normal(size=(2, 4, 8)).astype(np.float32)
+        a = np.asarray(net.rnn_time_step(x[:, 0]))
+        net.rnn_clear_previous_state()
+        b = np.asarray(net.rnn_time_step(x[:, 0]))
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_graph_transformer_streams(self, rng):
+        """The DSL transformer (ComputationGraph) streams with caches on
+        every block's attention."""
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = transformer_lm(7, n_layers=2, d_model=16, n_heads=2,
+                              d_ff=32, seed=4)
+        for v in conf.vertices.values():
+            layer = getattr(v, "layer", None)
+            if layer is not None and hasattr(layer, "max_cache_t"):
+                layer.max_cache_t = 16
+        net = ComputationGraph(conf).init()
+        ids = np.random.default_rng(0).integers(0, 7, (2, 6))
+        x = np.eye(7, dtype=np.float32)[ids]
+        full = np.asarray(net.output([x]))
+        for i in range(6):
+            step = np.asarray(net.rnn_time_step(x[:, i]))
+            np.testing.assert_allclose(step, full[:, i], rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_no_cache_layers_unaffected(self, rng):
+        """max_cache_t=None: output() and training behave exactly as
+        before (the streaming branch never fires)."""
+        net_a = self._mln(max_cache_t=None)
+        net_b = self._mln(max_cache_t=16)
+        x = rng.normal(size=(2, 6, 8)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net_a.output(x)),
+                                   np.asarray(net_b.output(x)), atol=1e-6)
+        y = np.eye(5, dtype=np.float32)[np.random.default_rng(1)
+                                        .integers(0, 5, (2, 6))]
+        la = float(net_a.fit_batch(x, y))
+        lb = float(net_b.fit_batch(x, y))
+        assert la == pytest.approx(lb, abs=1e-6)
+
+    def test_streaming_guards(self, rng):
+        """Review regressions: non-causal streaming rejected; over-long
+        chunks fail at trace; bf16 policy gets an exactly-counting cache."""
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu import dtypes as _dtypes
+        bi = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=False,
+                                max_cache_t=8)
+        with pytest.raises(ValueError, match="causal"):
+            bi._zero_state(2, _dtypes.default_policy())
+        net = self._mln(max_cache_t=4)
+        x = rng.normal(size=(2, 6, 8)).astype(np.float32)
+        with pytest.raises(ValueError, match="exceeds"):
+            net.rnn_time_step(x)   # 6-step chunk > max_cache_t=4
+        # bf16 compute policy: the cache (and its in-band counter) is f32
+        layer = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=True,
+                                   max_cache_t=8)
+        h, c = layer._zero_state(2, _dtypes.policy_from_name("mixed_bf16"))
+        assert h.dtype == jnp.float32
