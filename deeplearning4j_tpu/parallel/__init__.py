@@ -27,7 +27,7 @@ from .expert import ExpertParallelGraphTrainer, ExpertParallelTrainer
 from .mesh import create_mesh, data_parallel_mesh, mesh_devices
 from .pipeline import GraphPipelineTrainer, PipelineParallelTrainer
 from .sequence import SequenceParallelGraphTrainer
-from .tensor import TensorParallelTrainer
+from .tensor import TensorParallelGraphTrainer, TensorParallelTrainer
 from .training_master import (ParameterAveragingTrainingMaster,
                               SyncTrainingMaster, Trainer, TrainingMaster)
 from .wrapper import ParallelWrapper
@@ -39,4 +39,4 @@ __all__ = ["ParallelWrapper", "create_mesh", "data_parallel_mesh",
            "ParameterAveragingTrainingMaster", "TensorParallelTrainer",
            "PipelineParallelTrainer", "GraphPipelineTrainer",
            "SequenceParallelGraphTrainer", "ExpertParallelTrainer",
-           "ExpertParallelGraphTrainer"]
+           "ExpertParallelGraphTrainer", "TensorParallelGraphTrainer"]

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
+from .dsl_trainer import ShardedDSLTrainerBase
 
 Pytree = Any
 
@@ -143,3 +144,31 @@ class TensorParallelTrainer:
         net._score = loss
         net._fire_iteration(x.shape[0], loss)
         return loss
+
+
+class TensorParallelGraphTrainer(ShardedDSLTrainerBase):
+    """Tensor-parallel training for DSL models (``ComputationGraph`` or
+    ``MultiLayerNetwork``): big weights column-parallel over
+    ``model_axis`` via :func:`param_partition_specs`, batch over
+    ``data_axis`` when present — GSPMD partitions every matmul and
+    inserts the collectives. Shares the sharded-trainer contract (masks,
+    TBPTT chunk rejection, ``output()``) with the sequence/expert
+    trainers via ``ShardedDSLTrainerBase``; the original
+    ``TensorParallelTrainer`` remains the MLN-tuned fast path.
+    """
+
+    _api = "TensorParallelGraphTrainer"
+
+    def __init__(self, net, mesh: Mesh, *, data_axis: str = "data",
+                 model_axis: str = "model"):
+        if net.params is None:
+            net.init()
+        if model_axis not in mesh.axis_names:
+            raise ValueError(f"model_axis {model_axis!r} not in mesh "
+                             f"{mesh.axis_names}")
+        self.model_axis = model_axis
+        batch_axis = data_axis if data_axis in mesh.axis_names else None
+        specs = param_partition_specs(net, model_axis, mesh)
+        shardings = _shardings(specs, mesh)
+        self._build(net, mesh, x_spec=P(batch_axis), mask_spec=P(batch_axis),
+                    batch_axis=batch_axis, param_shardings=shardings)

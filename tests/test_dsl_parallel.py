@@ -338,3 +338,32 @@ class TestReviewRegressions:
         # T <= fwd_length: single chunk == full-sequence BPTT -> allowed
         conf2.tbptt_fwd_length = 16
         assert np.isfinite(float(pp.fit_batch(x, y)))
+
+
+class TestTensorParallelDSL:
+    def test_tp_graph_matches_single_device(self):
+        """Tensor parallelism serves ComputationGraphs too: the DSL
+        transformer with big weights column-sharded over `model`
+        (2-D data x model mesh) — loss parity vs single-device."""
+        from deeplearning4j_tpu.parallel import TensorParallelGraphTrainer
+        net_tp, net_ref = _net(), _net()
+        x, y = _data()
+        tp = TensorParallelGraphTrainer(
+            net_tp, create_mesh({"data": 2, "model": 4}))
+        # params genuinely sharded: the FFN kernel's out dim over `model`
+        w = net_tp.params["blk0_ff1"]["W"]
+        assert w.sharding.spec[-1] == "model"
+        for _ in range(3):
+            l_tp = float(tp.fit_batch(x, y))
+            l_ref = float(net_ref.fit_batch([x], [y]))
+            assert l_tp == pytest.approx(l_ref, abs=1e-4)
+        assert _max_param_diff(net_tp.params, net_ref.params) < 1e-5
+
+    def test_tp_graph_output_matches(self):
+        from deeplearning4j_tpu.parallel import TensorParallelGraphTrainer
+        net_tp, net_ref = _net(), _net()
+        x, _ = _data()
+        tp = TensorParallelGraphTrainer(net_tp, create_mesh({"model": 8}))
+        np.testing.assert_allclose(np.asarray(tp.output(x)),
+                                   np.asarray(net_ref.output([x])),
+                                   atol=1e-5)
