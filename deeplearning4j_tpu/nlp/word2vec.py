@@ -113,6 +113,16 @@ class WordVectorSerializer:
         the original word2vec C tool emits and the ecosystem interchanges."""
         opener = gzip.open if path.endswith(".gz") else open
         syn0 = np.asarray(model._syn0(), dtype="<f4")
+        # the format's only word terminator is a single space, so any
+        # whitespace inside a token desynchronizes every reader (ours and
+        # the ecosystem's) from the first such word on — refuse at write
+        # time instead of emitting a corrupt file
+        for word in model.vocab.words():
+            if word != word.strip() or any(ch.isspace() for ch in word):
+                raise ValueError(
+                    f"vocab word {word!r} contains whitespace — the "
+                    "word2vec C binary format cannot represent it; clean "
+                    "the tokenization before writing binary vectors")
         with opener(path, "wb") as f:
             f.write(f"{model.vocab.num_words()} {model.layer_size}\n"
                     .encode("utf-8"))

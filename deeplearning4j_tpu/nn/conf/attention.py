@@ -45,9 +45,10 @@ class SelfAttentionLayer(Layer):
     # cache — rnn_time_step then attends WITHIN each fed chunk only (no
     # history), which is almost never what you want for attention; set
     # max_cache_t for true incremental decode. Feeding more than
-    # max_cache_t TOTAL steps silently clamps (the tail overwrites) —
-    # reset with rnn_clear_previous_state() between sequences. Causal
-    # layers only.
+    # max_cache_t TOTAL steps clamps (the tail overwrites); the runtimes
+    # count fed steps host-side and emit a RuntimeWarning at the first
+    # overflow (util.netutil.note_streamed_steps) — reset with
+    # rnn_clear_previous_state() between sequences. Causal layers only.
     max_cache_t: Optional[int] = None
 
     def output_type(self, input_type: InputType) -> InputType:

@@ -26,6 +26,7 @@ from .. import losses as _losses
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
 from ..util import xla as _xla
+from ..util.netutil import note_streamed_steps as _note_streamed_steps
 from .conf.graph import ComputationGraphConfiguration, LayerVertex
 from .conf.preprocessors import call_preprocessor
 
@@ -55,6 +56,7 @@ class ComputationGraph:
         self._score = None
         self._updater = None
         self._rnn_state: Optional[Dict[str, Dict[str, jax.Array]]] = None
+        self._rnn_steps_fed = 0    # streaming steps since last cache reset
         self._jit_cache: Dict[str, Any] = {}
 
         self._output_layer_names = [
@@ -319,6 +321,7 @@ class ComputationGraph:
             # streaming call from plain output() by the presence of the
             # carried cache
             self._rnn_state = self._zero_rnn_carry(inputs[0].shape[0])
+            self._rnn_steps_fed = 0
         fn = self._jit_cache.get("rnn_time_step")
         if fn is None:
             @jax.jit
@@ -332,6 +335,9 @@ class ComputationGraph:
             self._jit_cache["rnn_time_step"] = fn
         outs, self._rnn_state = fn(self.params,
                                    self._states_map(self._rnn_state), inputs)
+        # count only steps the cache actually absorbed (a rejected chunk
+        # raised above and never touched it)
+        _note_streamed_steps(self, inputs[0].shape[1])
         if squeeze:
             outs = [o[:, 0, :] if o.ndim == 3 else o for o in outs]
         return outs[0] if len(outs) == 1 else outs
@@ -339,6 +345,7 @@ class ComputationGraph:
     def rnn_clear_previous_state(self) -> None:
         """Reset the streaming rnn carry (parity: ``rnnClearPreviousState``)."""
         self._rnn_state = None
+        self._rnn_steps_fed = 0
 
     def feed_forward(self, *inputs, train: bool = False) -> Dict[str, jax.Array]:
         """All vertex activations keyed by name."""

@@ -41,6 +41,7 @@ from .. import losses as _losses
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
 from ..util import xla as _xla
+from ..util.netutil import note_streamed_steps as _note_streamed_steps
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.preprocessors import call_preprocessor
 
@@ -68,6 +69,7 @@ class MultiLayerNetwork:
         self.epoch_count = 0
         self._score: Optional[float] = None
         self._rnn_state: Optional[List[Dict[str, jax.Array]]] = None
+        self._rnn_steps_fed = 0    # streaming steps since last cache reset
         self._updater = None
         self._jit_cache: Dict[str, Any] = {}
 
@@ -265,6 +267,7 @@ class MultiLayerNetwork:
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_state = None
+        self._rnn_steps_fed = 0
 
     def rnn_time_step(self, x):
         """Streaming inference: feed one (or a few) timesteps, carrying h/c
@@ -279,6 +282,7 @@ class MultiLayerNetwork:
             # streaming call from plain output() by the presence of the
             # carried cache
             self._rnn_state = self._zero_rnn_carry(x.shape[0])
+            self._rnn_steps_fed = 0
         fn = self._jit_cache.get("rnn_time_step")
         if fn is None:
             @jax.jit
@@ -289,6 +293,9 @@ class MultiLayerNetwork:
             self._jit_cache["rnn_time_step"] = fn
         out, self._rnn_state = fn(self.params,
                                   self._states_list(self._rnn_state), x)
+        # count only steps the cache actually absorbed (a rejected chunk
+        # raised above and never touched it)
+        _note_streamed_steps(self, x.shape[1])
         return out[:, 0, :] if (squeeze and out.ndim == 3) else out
 
     # ------------------------------------------------------------------

@@ -45,6 +45,13 @@ class TrainingListener:
     def on_backward_pass(self, model) -> None:
         pass
 
+    def on_step_skipped(self, model, iteration: int, reason: str) -> None:
+        """A training step was detected as divergent (e.g. non-finite
+        gradients) and skipped — the params did not move this iteration.
+        Fired by the resilience-guarded trainers (parallel wrapper /
+        sharded DSL trainers with ``skip_nonfinite_budget`` set)."""
+        pass
+
 
 class ScoreIterationListener(TrainingListener):
     """Log score every N iterations (parity: ScoreIterationListener.java)."""
@@ -149,6 +156,11 @@ class ComposableIterationListener(TrainingListener):
     def on_backward_pass(self, model):
         for l in self.listeners:
             l.on_backward_pass(model)
+
+    def on_step_skipped(self, model, iteration, reason):
+        for l in self.listeners:
+            if hasattr(l, "on_step_skipped"):
+                l.on_step_skipped(model, iteration, reason)
 
 
 class ParamAndGradientIterationListener(TrainingListener):

@@ -166,6 +166,35 @@ def apply_updates(params: Pytree, deltas: Pytree) -> Pytree:
                                   params, deltas)
 
 
+def all_finite(tree: Pytree) -> jax.Array:
+    """Scalar bool: every inexact leaf of ``tree`` is finite (trainers use
+    this on the gradient tree to skip divergent steps on-device)."""
+    ok = jnp.bool_(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def select_tree(ok: jax.Array, new: Pytree, old: Pytree) -> Pytree:
+    """Leaf-wise ``where(ok, new, old)`` that tolerates ``new`` growing
+    container entries ``old`` lacks (layer state dicts legitimately gain
+    keys at runtime, e.g. MoE aux_loss) — unmatched entries keep ``new``."""
+    if isinstance(new, dict):
+        old = old if isinstance(old, dict) else {}
+        return {k: select_tree(ok, v, old.get(k)) for k, v in new.items()}
+    if isinstance(new, (list, tuple)):
+        old = old if isinstance(old, (list, tuple)) else ()
+        seq = [select_tree(ok, v, old[i] if i < len(old) else None)
+               for i, v in enumerate(new)]
+        if isinstance(new, tuple):
+            return type(new)(*seq) if hasattr(new, "_fields") else tuple(seq)
+        return seq
+    if new is None or old is None or not hasattr(new, "dtype"):
+        return new
+    return jnp.where(ok, new, old)
+
+
 def _zeros_like_f32(params):
     return jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)

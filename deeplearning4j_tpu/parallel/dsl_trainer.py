@@ -57,11 +57,20 @@ class ShardedDSLTrainerBase:
     def _build(self, net, mesh: Mesh, *, x_spec: P, mask_spec: P,
                batch_axis: Optional[str] = None,
                param_shardings: Optional[Pytree] = None,
-               trace_ctx=None) -> None:
+               trace_ctx=None,
+               skip_nonfinite_budget: Optional[int] = None) -> None:
         from ..optimize import updaters as _updaters
 
         if net.params is None:
             net.init()
+        # resilience: with a budget, non-finite-gradient steps become
+        # on-device no-ops, counted on the host until the budget raises
+        # (see util.resilience.NonFiniteGuard). Off (None) by default.
+        self.nonfinite_guard = None
+        if skip_nonfinite_budget is not None:
+            from ..util.resilience import NonFiniteGuard
+            self.nonfinite_guard = NonFiniteGuard(
+                int(skip_nonfinite_budget), net)
         if batch_axis is not None and batch_axis not in mesh.axis_names:
             raise ValueError(f"batch_axis {batch_axis!r} not in mesh "
                              f"{mesh.axis_names}")
@@ -101,16 +110,26 @@ class ShardedDSLTrainerBase:
                                     None if masks is None else masks[0],
                                     rng)
 
+        guard = self.nonfinite_guard
+
         def step(params, opt_state, states, inputs, labels, masks, rng, it):
             with ctx():   # trace-time: bakes the mode's route into the jit
                 (loss, new_states), grads = jax.value_and_grad(
                     loss_call, has_aux=True)(
                         params, states, inputs, labels, masks, rng)
+            if guard is not None:
+                ok = jnp.logical_and(_updaters.all_finite(grads),
+                                     _updaters.all_finite(loss))
             grads = _updaters.normalize_gradients(grads, norm_kind,
                                                   norm_thr)
-            deltas, opt_state = updater.update(grads, opt_state, it)
-            params = _updaters.apply_updates(params, deltas)
-            return params, opt_state, new_states, loss
+            deltas, opt_state2 = updater.update(grads, opt_state, it)
+            params2 = _updaters.apply_updates(params, deltas)
+            if guard is None:
+                return params2, opt_state2, new_states, loss
+            params2 = _updaters.select_tree(ok, params2, params)
+            opt_state2 = _updaters.select_tree(ok, opt_state2, opt_state)
+            new_states = _updaters.select_tree(ok, new_states, states)
+            return params2, opt_state2, new_states, loss, ok
 
         self._step = jax.jit(step, donate_argnums=(0, 1))
 
@@ -160,13 +179,20 @@ class ShardedDSLTrainerBase:
         rng = _rng.fold_name(_rng.key(net.training.seed),
                              f"update_{net._update_count}")
         it = jnp.asarray(net._update_count, jnp.int32)
-        params, opt_state, new_states, loss = self._step(
+        out = self._step(
             net.params, net.updater_state, self._states(), xs, ys, ms,
             rng, it)
+        ok = None
+        if self.nonfinite_guard is not None:
+            params, opt_state, new_states, loss, ok = out
+        else:
+            params, opt_state, new_states, loss = out
         net.params = params
         net.updater_state = opt_state
         net._update_count += 1
         net._persist_states(new_states)
         net._score = loss
+        if ok is not None:
+            self.nonfinite_guard.step(ok)   # may raise once over budget
         net._fire_iteration(xs[0].shape[0], loss)
         return loss

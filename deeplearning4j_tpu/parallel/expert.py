@@ -212,11 +212,13 @@ class ExpertParallelGraphTrainer(ShardedDSLTrainerBase):
     _api = "ExpertParallelGraphTrainer"
 
     def __init__(self, net, mesh: Mesh, *, axis: str = "ep",
-                 batch_axis: Optional[str] = None):
+                 batch_axis: Optional[str] = None,
+                 skip_nonfinite_budget: Optional[int] = None):
         if net.params is None:
             net.init()
         self.axis = axis
         shardings = expert_param_shardings(net, mesh, axis)
         self._build(net, mesh,
                     x_spec=P(batch_axis), mask_spec=P(batch_axis),
-                    batch_axis=batch_axis, param_shardings=shardings)
+                    batch_axis=batch_axis, param_shardings=shardings,
+                    skip_nonfinite_budget=skip_nonfinite_budget)

@@ -24,7 +24,10 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import rng as _rng
@@ -74,9 +77,14 @@ def make_pipeline_forward(mesh: Mesh, axis: str, block_fn: BlockFn,
 
         # carries become device-varying inside the loop (ppermute / masked
         # writes), so their initial values must carry the same
-        # mesh-variance type
-        inflight0 = lax.pcast(jnp.zeros_like(xm[0]), axis, to="varying")
-        outs0 = lax.pcast(jnp.zeros_like(xm), axis, to="varying")
+        # mesh-variance type; older jax has no varying-type tracking (and
+        # no lax.pcast), so the zeros pass through untyped there
+        if hasattr(lax, "pcast"):
+            inflight0 = lax.pcast(jnp.zeros_like(xm[0]), axis, to="varying")
+            outs0 = lax.pcast(jnp.zeros_like(xm), axis, to="varying")
+        else:
+            inflight0 = jnp.zeros_like(xm[0])
+            outs0 = jnp.zeros_like(xm)
         (_, outs), _ = lax.scan(tick, (inflight0, outs0),
                                 jnp.arange(M + S - 1))
         # replicate the last stage's outputs to every device
@@ -415,9 +423,19 @@ class GraphPipelineTrainer:
         # loss_fn runs every vertex with rng=None (no dropout) and never
         # adds _reg_penalty, so dropout/l1/l2 anywhere would silently
         # diverge from the single-device run — reject loudly instead
+        from ..nn.conf.moe import MoELayer
+
         net, conf = self.net, self.net.conf
         for n in conf.topological_order():
             v = conf.vertices[n]
+            if isinstance(getattr(v, "layer", None), MoELayer):
+                # run_vertices drops vertex state, so the MoE aux_loss
+                # (load balancing) would silently vanish from the pipeline
+                # objective and diverge from the single-device loss
+                raise ValueError(
+                    f"vertex {n!r} is a MoELayer — its aux_loss cannot "
+                    "ride the pipeline schedule yet; use "
+                    "ExpertParallelGraphTrainer for MoE models")
             if v.init_state(net.policy):
                 raise ValueError(
                     f"vertex {n!r} carries state (e.g. BN running stats) — "

@@ -159,7 +159,8 @@ class SequenceParallelGraphTrainer(ShardedDSLTrainerBase):
 
     def __init__(self, net, mesh: Mesh, *, seq_axis: str = "seq",
                  batch_axis: Optional[str] = None,
-                 expert_axis: Optional[str] = None):
+                 expert_axis: Optional[str] = None,
+                 skip_nonfinite_budget: Optional[int] = None):
         from ..ops.attention import sequence_sharding
 
         if seq_axis not in mesh.axis_names:
@@ -179,6 +180,7 @@ class SequenceParallelGraphTrainer(ShardedDSLTrainerBase):
                     batch_axis=batch_axis,
                     param_shardings=param_shardings,
                     trace_ctx=lambda: sequence_sharding(mesh, seq_axis,
-                                                        batch_axis))
+                                                        batch_axis),
+                    skip_nonfinite_budget=skip_nonfinite_budget)
 
 

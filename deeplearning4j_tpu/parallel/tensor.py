@@ -160,7 +160,8 @@ class TensorParallelGraphTrainer(ShardedDSLTrainerBase):
     _api = "TensorParallelGraphTrainer"
 
     def __init__(self, net, mesh: Mesh, *, data_axis: str = "data",
-                 model_axis: str = "model"):
+                 model_axis: str = "model",
+                 skip_nonfinite_budget: Optional[int] = None):
         if net.params is None:
             net.init()
         if model_axis not in mesh.axis_names:
@@ -171,4 +172,5 @@ class TensorParallelGraphTrainer(ShardedDSLTrainerBase):
         specs = param_partition_specs(net, model_axis, mesh)
         shardings = _shardings(specs, mesh)
         self._build(net, mesh, x_spec=P(batch_axis), mask_spec=P(batch_axis),
-                    batch_axis=batch_axis, param_shardings=shardings)
+                    batch_axis=batch_axis, param_shardings=shardings,
+                    skip_nonfinite_budget=skip_nonfinite_budget)

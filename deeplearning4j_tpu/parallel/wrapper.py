@@ -77,7 +77,8 @@ class ParallelWrapper:
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None,
-                 averaging_frequency: int = 1, stats=None):
+                 averaging_frequency: int = 1, stats=None,
+                 skip_nonfinite_budget: Optional[int] = None):
         if net.params is None:
             net.init()
         self.net = net
@@ -87,6 +88,16 @@ class ParallelWrapper:
         self.averaging_frequency = int(averaging_frequency)
         self.n_devices = self.mesh.shape["data"]
         self._local: Optional[_LocalSgdState] = None
+        # resilience: with a budget set, steps whose gradients (or loss)
+        # are non-finite are skipped ON DEVICE (old params/opt-state kept)
+        # and counted on the host, raising once the budget is exhausted.
+        # The per-step finiteness read forces a host sync, so this is an
+        # opt-in robustness feature, off (None) by default.
+        self.nonfinite_guard = None
+        if skip_nonfinite_budget is not None:
+            from ..util.resilience import NonFiniteGuard
+            self.nonfinite_guard = NonFiniteGuard(
+                int(skip_nonfinite_budget), net)
         # phase timing (parity: SparkTrainingStats / StatsCalculationHelper);
         # stats=True builds a default collector, or pass a TrainingStats
         if stats is True:
@@ -113,19 +124,32 @@ class ParallelWrapper:
         repl = NamedSharding(self.mesh, P())
         bsh = NamedSharding(self.mesh, P("data"))
 
+        guard = self.nonfinite_guard
+
         def step(params, opt_state, states, x, y, mask, rng, iteration):
             (loss, new_states), grads = jax.value_and_grad(
                 net._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+            if guard is not None:
+                ok = jnp.logical_and(_updaters.all_finite(grads),
+                                     _updaters.all_finite(loss))
             grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
-            deltas, opt_state = updater.update(grads, opt_state, iteration)
-            params = _updaters.apply_updates(params, deltas)
-            return params, opt_state, new_states, loss
+            deltas, opt_state2 = updater.update(grads, opt_state, iteration)
+            params2 = _updaters.apply_updates(params, deltas)
+            if guard is None:
+                return params2, opt_state2, new_states, loss
+            # divergent step: keep the old params/opt-state/states (a pure
+            # no-op update); the host counts the skip against the budget
+            params2 = _updaters.select_tree(ok, params2, params)
+            opt_state2 = _updaters.select_tree(ok, opt_state2, opt_state)
+            new_states = _updaters.select_tree(ok, new_states, states)
+            return params2, opt_state2, new_states, loss, ok
 
+        n_out = 5 if guard is not None else 4
         jitted = jax.jit(
             step,
             donate_argnums=(0, 1),
             in_shardings=(repl, repl, repl, bsh, bsh, bsh, repl, repl),
-            out_shardings=(repl, repl, repl, repl))
+            out_shardings=tuple([repl] * n_out))
 
         n = self.n_devices
 
@@ -136,8 +160,21 @@ class ParallelWrapper:
                     f"batch size {bs} not divisible by the {n}-device "
                     "'data' mesh axis (sync SPMD mode shards the batch "
                     "evenly across devices)")
-            return jitted(params, opt_state, states, x, y, mask, rng,
-                          iteration)
+            out = jitted(params, opt_state, states, x, y, mask, rng,
+                         iteration)
+            if guard is None:
+                return out
+            params, opt_state, new_states, loss, ok = out
+            try:
+                guard.step(ok)
+            except Exception:
+                # the caller assigns net state only after we return, but
+                # the inputs were donated — hand the (unchanged, freshly
+                # selected) trees back so the net stays checkpointable
+                net.params = params
+                net.updater_state = opt_state
+                raise
+            return params, opt_state, new_states, loss
 
         return checked
 
@@ -278,28 +315,44 @@ class _LocalSgdState:
         updater = net._updater
         mesh = self.mesh
 
+        guard = self.pw.nonfinite_guard
+
         def per_replica(params, opt_state, states, x, y, mask, rng, iteration):
             # leading replica axis has block size 1 on each device — drop it
-            params = _tree_map(lambda a: a[0], params)
-            opt_state = _tree_map(lambda a: a[0], opt_state)
-            states = _tree_map(lambda a: a[0], states)
+            params0 = _tree_map(lambda a: a[0], params)
+            opt_state0 = _tree_map(lambda a: a[0], opt_state)
+            states0 = _tree_map(lambda a: a[0], states)
             # distinct dropout stream per replica
             rng = (None if rng is None
                    else jax.random.fold_in(rng, jax.lax.axis_index("data")))
             (loss, new_states), grads = jax.value_and_grad(
-                net._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
+                net._loss_fn, has_aux=True)(params0, states0, x, y, mask, rng)
+            if guard is not None:
+                ok = jnp.logical_and(_updaters.all_finite(grads),
+                                     _updaters.all_finite(loss))
             grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
-            deltas, opt_state = updater.update(grads, opt_state, iteration)
-            params = _updaters.apply_updates(params, deltas)
+            deltas, opt_state1 = updater.update(grads, opt_state0, iteration)
+            params1 = _updaters.apply_updates(params0, deltas)
+            if guard is not None:
+                # this replica diverged: its update becomes a no-op (the
+                # next averaging point re-syncs it with healthy replicas)
+                params1 = _updaters.select_tree(ok, params1, params0)
+                opt_state1 = _updaters.select_tree(ok, opt_state1, opt_state0)
+                new_states = _updaters.select_tree(ok, new_states, states0)
             put_back = lambda a: a[None] if hasattr(a, "shape") else a
-            return (_tree_map(put_back, params), _tree_map(put_back, opt_state),
-                    _tree_map(put_back, new_states), loss[None])
+            out = (_tree_map(put_back, params1),
+                   _tree_map(put_back, opt_state1),
+                   _tree_map(put_back, new_states), loss[None])
+            if guard is not None:
+                out = out + (ok[None],)
+            return out
 
         Pd, Pr = P("data"), P()
+        out_specs = (Pd, Pd, Pd, Pd) + ((Pd,) if guard is not None else ())
         step = shard_map(
             per_replica, mesh=mesh,
             in_specs=(Pd, Pd, Pd, Pd, Pd, Pd, Pr, Pr),
-            out_specs=(Pd, Pd, Pd, Pd))
+            out_specs=out_specs)
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _make_avg(self):
@@ -321,8 +374,25 @@ class _LocalSgdState:
         rng = _rng.fold_name(_rng.key(net.training.seed),
                              f"update_{net._update_count}")
         it = jnp.asarray(net._update_count, jnp.int32)
-        self.params, self.opt_state, self.states, loss = self._step(
+        out = self._step(
             self.params, self.opt_state, self.states, x, y, mask, rng, it)
+        guard = self.pw.nonfinite_guard
+        if guard is not None:
+            self.params, self.opt_state, self.states, loss, oks = out
+            n_bad = int(oks.size) - int(jnp.sum(oks))
+            try:
+                guard.step(n_bad == 0,
+                           detail=(f"{n_bad}/{oks.size} replicas diverged; "
+                                   "re-synced at next averaging"
+                                   if n_bad else ""))
+            except Exception:
+                # budget exhausted mid-window: average the healthy
+                # replicas' progress back into the net so the caller can
+                # still checkpoint (mirrors the sync path's guarantee)
+                self.sync_to_net()
+                raise
+        else:
+            self.params, self.opt_state, self.states, loss = out
         net._update_count += 1
         self._steps_since_avg += 1
         if self._steps_since_avg >= self.k:

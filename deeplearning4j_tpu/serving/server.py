@@ -2,7 +2,9 @@
 
 Endpoints:
   POST /predict   {"inputs": [[...], ...]} → {"outputs": [[...], ...]}
-  GET  /healthz   {"ok": true, "model": "...", "served": N}
+  GET  /healthz   {"ok": true, "model": "...", "served": N,
+                   "queue_depth": n, "queue_capacity": n,
+                   "breaker": "closed|open|half_open", "draining": bool}
   POST /model     swap the served model from a checkpoint zip path
                   {"path": "/path/to/model.zip"}
 
@@ -12,6 +14,23 @@ first) into ONE ``model.output`` call — the serving analog of
 AsyncDataSetIterator's prefetch coalescing, and the right shape for a
 compiled accelerator backend (per-request dispatch would be latency-bound).
 Fixed batch buckets avoid per-size recompilation under jit.
+
+Resilience (rides :mod:`deeplearning4j_tpu.util.resilience`):
+
+- **Load shedding**: the request queue is bounded (``max_queue``
+  examples); an overloaded server answers 503 + ``Retry-After``
+  immediately instead of stacking unbounded latency.
+- **Per-request deadlines**: every request carries a deadline
+  (``request_timeout_s``); the batcher never spends a model call on a
+  request whose client has already given up (expired entries answer 504).
+- **Circuit breaker**: consecutive model failures trip the breaker — new
+  predicts answer 503 + ``Retry-After`` for the cool-down instead of
+  feeding a broken model; one probe batch then decides recovery.
+- **Graceful drain**: ``stop(drain=True)`` stops admitting work, answers
+  everything already queued, then shuts down — no request is dropped
+  mid-flight on a planned restart.
+
+Fault seam: ``"serving.infer"`` around the batched model call.
 """
 
 from __future__ import annotations
@@ -21,19 +40,24 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from ..util import faults as _faults
+from ..util.resilience import SYSTEM_CLOCK, CircuitBreaker, Clock, Deadline
+
 
 class _Pending:
-    __slots__ = ("x", "event", "result", "error")
+    __slots__ = ("x", "event", "result", "error", "code", "deadline")
 
-    def __init__(self, x: np.ndarray):
+    def __init__(self, x: np.ndarray, deadline: Deadline):
         self.x = x
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[str] = None
+        self.code: int = 500
+        self.deadline = deadline
 
 
 class InferenceServer:
@@ -41,15 +65,31 @@ class InferenceServer:
 
     def __init__(self, model, port: int = 0, *, max_batch: int = 64,
                  batch_timeout_ms: float = 5.0,
-                 pad_to_buckets: bool = True):
+                 pad_to_buckets: bool = True,
+                 max_queue: int = 256,
+                 request_timeout_s: float = 30.0,
+                 breaker: Optional[CircuitBreaker] = None,
+                 clock: Clock = SYSTEM_CLOCK):
         self._model = model
         self.max_batch = int(max_batch)
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
         self.pad_to_buckets = pad_to_buckets
+        self.request_timeout_s = float(request_timeout_s)
+        self.clock = clock
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=3, reset_timeout_s=5.0, clock=clock,
+            name="serving-model")
         self.served = 0
-        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self.shed = 0            # requests answered 503 (queue full/draining)
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(
+            maxsize=int(max_queue))
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._draining = False
+        # admitted-but-unanswered requests; drain() waits on this, not on
+        # queue emptiness (an item leaves the queue before it is answered)
+        self._pending = 0
+        self._pending_lock = threading.Lock()
         self._batcher = threading.Thread(target=self._batch_loop, daemon=True)
         self._batcher.start()
 
@@ -59,19 +99,19 @@ class InferenceServer:
             def log_message(self, *a):
                 pass
 
-            def _json(self, obj, code=200):
+            def _json(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._json({"ok": True,
-                                "model": type(outer._model).__name__,
-                                "served": outer.served})
+                    self._json(outer._health())
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -88,9 +128,11 @@ class InferenceServer:
                     except Exception as e:
                         self._json({"error": f"bad inputs: {e}"}, 400)
                         return
-                    out, err = outer._predict(x)
+                    out, err, code, retry_after = outer._predict(x)
                     if err is not None:
-                        self._json({"error": err}, 500)
+                        headers = ({"Retry-After": f"{retry_after:.0f}"}
+                                   if retry_after is not None else None)
+                        self._json({"error": err}, code, headers)
                     else:
                         self._json({"outputs": out.tolist()})
                 elif self.path == "/model":
@@ -110,15 +152,53 @@ class InferenceServer:
 
     # ------------------------------------------------------------------
 
-    def _predict(self, x: np.ndarray):
-        p = _Pending(x)
-        self._queue.put(p)
-        p.event.wait(timeout=60.0)
+    def _health(self) -> dict:
+        return {"ok": not self._draining
+                      and self.breaker.state != "open",
+                "model": type(self._model).__name__,
+                "served": self.served,
+                "shed": self.shed,
+                "queue_depth": self._queue.qsize(),
+                "queue_capacity": self._queue.maxsize,
+                "breaker": self.breaker.state,
+                "draining": self._draining}
+
+    def _predict(self, x: np.ndarray
+                 ) -> Tuple[Optional[np.ndarray], Optional[str],
+                            int, Optional[float]]:
+        """Returns (outputs, error, http_code, retry_after_s)."""
+        if self._draining or self._stop.is_set():
+            self.shed += 1
+            return None, "server is draining", 503, 1.0
+        if not self.breaker.allow():
+            retry = max(1.0, self.breaker.retry_after())
+            return (None, "model circuit open (failing upstream)", 503,
+                    retry)
+        p = _Pending(x, Deadline(self.request_timeout_s, self.clock))
+        with self._pending_lock:
+            self._pending += 1
+        try:
+            self._queue.put_nowait(p)
+        except queue.Full:
+            # bounded-queue load shedding: an honest 503 now beats an
+            # unbounded queue that times every client out later
+            with self._pending_lock:
+                self._pending -= 1
+            self.shed += 1
+            return (None, "server overloaded (queue full)", 503,
+                    max(1.0, self.batch_timeout_s))
+        p.event.wait(timeout=self.request_timeout_s + 1.0)
         if p.error is not None:
-            return None, p.error
+            return None, p.error, p.code, None
         if p.result is None:
-            return None, "inference timeout"
-        return p.result, None
+            return None, "inference timeout", 504, None
+        return p.result, None, 200, None
+
+    def _finish(self, p: _Pending) -> None:
+        """Answer a pending request (exactly once per admitted request)."""
+        p.event.set()
+        with self._pending_lock:
+            self._pending -= 1
 
     def _batch_loop(self) -> None:
         while not self._stop.is_set():
@@ -139,7 +219,18 @@ class InferenceServer:
                     break
                 batch.append(p)
                 n += p.x.shape[0]
-            self._run_batch(batch)
+            # expired requests: their client already gave up — answer
+            # 504 and spend the model call on the live ones only
+            live = []
+            for p in batch:
+                if p.deadline.expired:
+                    p.error = "request deadline exceeded"
+                    p.code = 504
+                    self._finish(p)
+                else:
+                    live.append(p)
+            if live:
+                self._run_batch(live)
 
     def _bucket(self, n: int) -> int:
         b = 1
@@ -157,18 +248,22 @@ class InferenceServer:
                     x = np.concatenate(
                         [x, np.zeros((b - n,) + x.shape[1:], x.dtype)])
             with self._lock:
+                _faults.check("serving.infer", {"batch": n})
                 out = np.asarray(self._model.output(x))[:n]
             ofs = 0
             for p in batch:
                 k = p.x.shape[0]
                 p.result = out[ofs:ofs + k]
                 ofs += k
-                p.event.set()
+                self._finish(p)
             self.served += n
+            self.breaker.record_success()
         except Exception as e:
+            self.breaker.record_failure()
             for p in batch:
                 p.error = f"{type(e).__name__}: {e}"
-                p.event.set()
+                p.code = 500
+                self._finish(p)
 
     # ------------------------------------------------------------------
 
@@ -182,6 +277,34 @@ class InferenceServer:
         from ..util.serialization import load_model
         self.set_model(load_model(path))
 
-    def stop(self) -> None:
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting new predicts (they answer 503) and wait until
+        everything already queued has been answered. True if fully
+        drained within ``timeout``."""
+        self._draining = True
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            with self._pending_lock:
+                if self._pending == 0:
+                    return True
+            time.sleep(0.005)
+        with self._pending_lock:
+            return self._pending == 0
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful shutdown: by default drains queued requests first so a
+        planned restart drops nothing mid-flight."""
+        if drain:
+            self.drain(timeout)
         self._stop.set()
+        # answer anything still queued (drain=False or drain timeout)
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            p.error = "server shutting down"
+            p.code = 503
+            self._finish(p)
         self._httpd.shutdown()
+        self._batcher.join(timeout=5.0)

@@ -7,9 +7,15 @@ RemoteUIStatsStorageRouter.java`` — workers/Spark executors route their
 ``POST /api/remote`` endpoint (:mod:`deeplearning4j_tpu.ui.server`).
 
 Async by design (like the reference): a daemon thread drains a bounded
-queue so a slow/unreachable UI never blocks the training loop; after
-``max_retries`` consecutive failures records are dropped with a warning
-(the reference behaves the same — stats are best-effort telemetry).
+queue so a slow/unreachable UI never blocks the training loop. Delivery
+rides the resilience substrate (:mod:`deeplearning4j_tpu.util.resilience`)
+instead of a fixed-count hammer loop: per-record exponential-backoff
+retries under a :class:`RetryPolicy`, behind a :class:`CircuitBreaker` —
+consecutive failures trip the breaker OPEN and further records are
+dropped immediately (stats are best-effort telemetry) until the cool-down
+lets one probe through. Clock and transport are injectable, so the whole
+failure story is tested deterministically (no real sleeps or sockets);
+fault seam: ``"storage.post"``.
 """
 
 from __future__ import annotations
@@ -19,8 +25,11 @@ import queue
 import threading
 import urllib.request
 import warnings
-from typing import Optional
+from typing import Callable, Optional
 
+from ..util import faults as _faults
+from ..util.resilience import (SYSTEM_CLOCK, CircuitBreaker, Clock,
+                               RetryPolicy)
 from .stats_storage import Persistable, StatsStorageRouter
 
 
@@ -30,10 +39,22 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
     _SENTINEL = object()
 
     def __init__(self, url: str, *, queue_size: int = 1000,
-                 max_retries: int = 3, timeout: float = 5.0):
+                 max_retries: int = 3, timeout: float = 5.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 clock: Clock = SYSTEM_CLOCK,
+                 transport: Optional[Callable[[str, bytes, float],
+                                              None]] = None):
         self.url = url.rstrip("/") + "/api/remote"
-        self.max_retries = int(max_retries)
         self.timeout = float(timeout)
+        self.clock = clock
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=int(max_retries), initial_backoff=0.2,
+            max_backoff=5.0, deadline_s=30.0, clock=clock)
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=5, reset_timeout_s=30.0, clock=clock,
+            name=f"remote-ui[{self.url}]")
+        self._transport = transport or self._http_post
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._dropped = 0
         self._posted = 0
@@ -59,19 +80,32 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
         except queue.Full:
             self._dropped += 1
 
+    def _http_post(self, url: str, body: bytes, timeout: float) -> None:
+        """Default transport: one HTTP round-trip; raises on any failure."""
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            if not (200 <= r.status < 300):
+                raise IOError(f"remote UI returned status {r.status}")
+
     def _post(self, kind: str, record: Persistable) -> bool:
         body = json.dumps({"kind": kind,
                            "record": json.loads(record.to_json())}).encode()
-        req = urllib.request.Request(
-            self.url, data=body, method="POST",
-            headers={"Content-Type": "application/json"})
-        for _ in range(self.max_retries):
+        for _attempt in self.retry_policy.attempts():
+            # the breaker gates every attempt: tripping OPEN mid-loop
+            # stops the remaining retries from hammering a dead UI
+            if not self.breaker.allow():
+                return False
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    if 200 <= r.status < 300:
-                        return True
+                _faults.check("storage.post", {"url": self.url,
+                                               "body": body})
+                self._transport(self.url, body, self.timeout)
             except Exception:
-                pass
+                self.breaker.record_failure()
+                continue
+            self.breaker.record_success()
+            return True
         return False
 
     def _drain(self) -> None:

@@ -8,3 +8,49 @@ def is_graph(net) -> bool:
     False for MultiLayerNetwork-shaped ones. Structural, so subclasses and
     wrappers classify correctly."""
     return hasattr(net, "topo_order")
+
+
+def streaming_cache_limit(net):
+    """Smallest ``max_cache_t`` among the net's streaming-cached layers
+    (attention K/V caches), or None when nothing carries a bounded cache.
+    Feeding more total steps than this through ``rnn_time_step`` overflows
+    the cache (the tail overwrites) — the runtimes count fed steps against
+    it and warn instead of silently degrading."""
+    if is_graph(net):
+        layers = (getattr(v, "layer", None)
+                  for v in net.conf.vertices.values())
+    else:
+        layers = net.layers
+    limits = [l.max_cache_t for l in layers
+              if l is not None and getattr(l, "max_cache_t", None) is not None]
+    return min(limits) if limits else None
+
+
+_UNSET = object()
+
+
+def note_streamed_steps(net, t_new: int) -> None:
+    """Host-side streaming overflow counter: add ``t_new`` fed steps to the
+    net's tally and warn ONCE when the total first exceeds the smallest
+    streaming cache (``max_cache_t``) — past that point the cache tail is
+    overwritten and decoded positions silently stop matching the true
+    global positions. Reset by ``rnn_clear_previous_state()``. The limit
+    is memoized on the net: this runs once per token in decode loops, and
+    cache sizes are fixed at layer-config time."""
+    limit = getattr(net, "_stream_cache_limit_memo", _UNSET)
+    if limit is _UNSET:
+        limit = streaming_cache_limit(net)
+        net._stream_cache_limit_memo = limit
+    if limit is None:
+        return
+    prev = net._rnn_steps_fed
+    net._rnn_steps_fed = prev + int(t_new)
+    if net._rnn_steps_fed > limit >= prev:
+        import warnings
+        warnings.warn(
+            f"rnn_time_step has been fed {net._rnn_steps_fed} total steps "
+            f"but the smallest streaming K/V cache holds max_cache_t="
+            f"{limit}; the cache tail is now overwritten and outputs no "
+            "longer reflect true global positions — call "
+            "rnn_clear_previous_state() between sequences or raise "
+            "max_cache_t", RuntimeWarning, stacklevel=3)

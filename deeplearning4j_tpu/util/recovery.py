@@ -19,11 +19,16 @@ arbitrary iterator.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 from typing import List, Optional
 
-from .serialization import load_model, save_model
+from . import faults as _faults
+from .serialization import (CheckpointInvalid, load_model, save_model,
+                            verify_checkpoint)
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 _KIND_RES = {
     "boundary": re.compile(r"^checkpoint_epoch(\d+)_iter(\d+)\.zip$"),
@@ -34,11 +39,14 @@ _KIND_RES = {
 class CheckpointRecovery:
     """Rolling checkpoint store in one directory (single writer).
 
-    ``latest()`` / ``restore()`` pick the newest checkpoint by (epoch,
-    iteration); ``save(net)`` writes atomically (tmp + rename) and prunes
-    each kind to ``keep`` newest — a crash mid-write never corrupts a
-    recovery point. Stale ``.tmp_*`` files from crashed writers are swept
-    on construction (the directory has one writer at a time by contract).
+    ``latest()`` picks the newest checkpoint by (epoch, iteration);
+    ``restore()`` / ``latest_valid()`` additionally validate integrity
+    (zip CRC + sha256 manifest) and fall back to the newest VALID one, so
+    a corrupt or truncated latest never blocks recovery. ``save(net)``
+    writes atomically (tmp + rename) and prunes each kind to ``keep``
+    newest — a crash mid-write never corrupts a recovery point. Stale
+    ``.tmp_*``/``.wip_*`` files from crashed writers are swept on
+    construction (the directory has one writer at a time by contract).
     """
 
     def __init__(self, directory: str, keep: int = 2):
@@ -46,7 +54,7 @@ class CheckpointRecovery:
         self.keep = max(1, int(keep))
         os.makedirs(directory, exist_ok=True)
         for name in os.listdir(directory):
-            if name.startswith(".tmp_"):
+            if name.startswith((".tmp_", ".wip_")):
                 try:
                     os.remove(os.path.join(directory, name))
                 except OSError:
@@ -61,6 +69,22 @@ class CheckpointRecovery:
     def latest(self, kind: str = "boundary") -> Optional[str]:
         cps = self._checkpoints(kind)
         return os.path.join(self.directory, cps[-1]) if cps else None
+
+    def latest_valid(self, kind: str = "boundary") -> Optional[str]:
+        """Newest checkpoint that passes integrity validation (zip CRC +
+        checksum manifest). Invalid files — truncated by a torn write,
+        flipped bytes, empty — are skipped with a warning, so a corrupt
+        latest never blocks recovery while an older valid point exists."""
+        for name in reversed(self._checkpoints(kind)):
+            path = os.path.join(self.directory, name)
+            try:
+                verify_checkpoint(path)
+                return path
+            except CheckpointInvalid as e:
+                logger.warning(
+                    "skipping corrupt checkpoint %s (%s) — falling back "
+                    "to the previous one", path, e)
+        return None
 
     def save(self, net, kind: str = "boundary") -> str:
         prefix = "checkpoint" if kind == "boundary" else "periodic"
@@ -78,11 +102,21 @@ class CheckpointRecovery:
         return final
 
     def restore(self, kind: str = "boundary"):
-        """Newest checkpointed model of the given kind, or None."""
-        path = self.latest(kind)
-        if path is None:
-            return None
-        return load_model(path, load_updater=True)
+        """Newest VALID checkpointed model of the given kind, or None.
+        Corrupt/truncated checkpoints are skipped (see
+        :meth:`latest_valid`); a checkpoint that validates but still fails
+        to load falls back to the next older one the same way."""
+        for name in reversed(self._checkpoints(kind)):
+            path = os.path.join(self.directory, name)
+            try:
+                verify_checkpoint(path)
+                _faults.check("recovery.restore", {"path": path})
+                return load_model(path, load_updater=True)
+            except Exception as e:
+                logger.warning(
+                    "checkpoint %s unusable (%s: %s) — falling back to "
+                    "the previous one", path, type(e).__name__, e)
+        return None
 
 
 class RecoverableTrainer:

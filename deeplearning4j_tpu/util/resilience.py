@@ -1,0 +1,322 @@
+"""Resilience substrate: retry/backoff, circuit breaking, deadlines.
+
+Parity-plus: the reference delegates fault tolerance entirely to Spark
+task retry (SURVEY §5 — nothing bespoke in-tree). This reproduction owns
+serving, remote stats, checkpointing and multi-step training loops, so it
+owns ONE composable fault story instead of per-module ad-hoc loops:
+
+- :class:`RetryPolicy` — bounded attempts with exponential backoff and an
+  overall deadline.
+- :class:`CircuitBreaker` — consecutive failures trip OPEN; after a
+  cool-down one HALF_OPEN probe decides between CLOSED and re-OPEN, so an
+  unreachable dependency is probed, not hammered.
+- :class:`Deadline` — an absolute time budget threaded through queues and
+  request handlers.
+- :class:`NonFiniteGuard` — host-side budget for skipped non-finite
+  training steps (the trainers select old params on-device; this decides
+  when skipping becomes raising).
+
+Everything takes an injectable :class:`Clock`, so every failure path is
+driven deterministically from tests (``ManualClock`` — no real sleeps),
+in the spirit of hypothesis-style deterministic fault injection; see
+:mod:`deeplearning4j_tpu.util.faults` for the companion injection
+harness.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class ResilienceError(Exception):
+    """Base class for failures raised by the resilience substrate."""
+
+
+class RetriesExhausted(ResilienceError):
+    """A RetryPolicy ran out of attempts/deadline. ``__cause__`` holds the
+    last underlying error."""
+
+
+class CircuitOpenError(ResilienceError):
+    """The call was refused because the circuit breaker is OPEN."""
+
+    def __init__(self, msg: str, retry_after: float = 0.0):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+
+
+class DeadlineExceeded(ResilienceError):
+    """A Deadline expired before the work completed."""
+
+
+class Clock:
+    """Injectable time source. The default reads the monotonic clock and
+    really sleeps; tests substitute :class:`ManualClock`."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+class ManualClock(Clock):
+    """Deterministic clock for tests: ``sleep`` advances virtual time
+    instantly and records the requested durations."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+        self.sleeps: list = []
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(float(seconds))
+        self.now += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        self.now += float(seconds)
+
+
+SYSTEM_CLOCK = Clock()
+
+
+class Deadline:
+    """An absolute point in (clock) time a unit of work must finish by."""
+
+    def __init__(self, budget_s: Optional[float], clock: Clock = SYSTEM_CLOCK):
+        self.clock = clock
+        self._at = (None if budget_s is None
+                    else clock.monotonic() + float(budget_s))
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left (None = unbounded); never negative."""
+        if self._at is None:
+            return None
+        return max(0.0, self._at - self.clock.monotonic())
+
+    @property
+    def expired(self) -> bool:
+        return self._at is not None and self.clock.monotonic() >= self._at
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired:
+            raise DeadlineExceeded(f"{what} exceeded its deadline")
+
+
+class RetryPolicy:
+    """Exponential-backoff retry with bounded attempts and a total
+    deadline.
+
+    ``call(fn)`` runs ``fn`` up to ``max_attempts`` times, sleeping
+    ``initial_backoff * multiplier**k`` (capped at ``max_backoff``)
+    between attempts via the injected clock. A ``deadline_s`` bounds the
+    WHOLE retry loop: no retry is begun (nor slept toward) past it.
+    Raises :class:`RetriesExhausted` chaining the last error.
+    """
+
+    def __init__(self, *, max_attempts: int = 3,
+                 initial_backoff: float = 0.1, max_backoff: float = 10.0,
+                 multiplier: float = 2.0,
+                 deadline_s: Optional[float] = None,
+                 retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                 clock: Clock = SYSTEM_CLOCK):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if multiplier < 1.0:
+            raise ValueError("multiplier must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.initial_backoff = float(initial_backoff)
+        self.max_backoff = float(max_backoff)
+        self.multiplier = float(multiplier)
+        self.deadline_s = deadline_s
+        self.retry_on = retry_on
+        self.clock = clock
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before attempt ``attempt`` (0-based; attempt 0 has none)."""
+        if attempt <= 0:
+            return 0.0
+        return min(self.max_backoff,
+                   self.initial_backoff * self.multiplier ** (attempt - 1))
+
+    def attempts(self) -> Iterator[int]:
+        """Yield attempt indices, sleeping the backoff between them and
+        stopping early when the policy deadline runs out."""
+        deadline = Deadline(self.deadline_s, self.clock)
+        for attempt in range(self.max_attempts):
+            if attempt > 0:
+                wait = self.backoff(attempt)
+                rem = deadline.remaining()
+                if rem is not None and wait >= rem:
+                    # the backoff alone would eat the rest of the deadline
+                    # — give up now instead of sleeping toward nothing
+                    return
+                self.clock.sleep(wait)
+            yield attempt
+
+    def call(self, fn: Callable, *args, **kwargs):
+        last: Optional[BaseException] = None
+        ran = 0
+        for _attempt in self.attempts():
+            ran += 1
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                last = e
+        cut = ("" if ran == self.max_attempts
+               else f" (deadline cut the loop short of {self.max_attempts})")
+        raise RetriesExhausted(
+            f"{getattr(fn, '__name__', fn)!r} failed after {ran} "
+            f"attempts{cut}") from last
+
+
+# breaker states
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Trip OPEN after ``failure_threshold`` consecutive failures; refuse
+    calls while OPEN; after ``reset_timeout_s`` allow ONE probe
+    (HALF_OPEN) — its success closes the circuit, its failure re-opens it
+    for another cool-down. Thread-safe; clock-injectable.
+    """
+
+    def __init__(self, *, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0,
+                 clock: Clock = SYSTEM_CLOCK, name: str = "breaker"):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.clock = clock
+        self.name = name
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.trips = 0          # times the breaker went CLOSED/HALF_OPEN→OPEN
+        self.rejected = 0       # calls refused while OPEN
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (self._state == OPEN
+                and self.clock.monotonic() - self._opened_at
+                >= self.reset_timeout_s):
+            self._state = HALF_OPEN
+            self._probe_inflight = False
+
+    def retry_after(self) -> float:
+        """Seconds until the next probe is allowed (0 when not OPEN)."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, self._opened_at + self.reset_timeout_s
+                       - self.clock.monotonic())
+
+    def allow(self) -> bool:
+        """True if a call may proceed now (counts a rejection otherwise).
+        In HALF_OPEN exactly ONE caller gets True (the probe); the rest
+        are refused until its outcome is recorded — a recovering
+        dependency meets one request, not a thundering herd."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == OPEN or (self._state == HALF_OPEN
+                                       and self._probe_inflight):
+                self.rejected += 1
+                return False
+            if self._state == HALF_OPEN:
+                self._probe_inflight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                logger.info("circuit %s closed after successful probe",
+                            self.name)
+            self._state = CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._maybe_half_open()
+            self._probe_inflight = False
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._state = OPEN
+                self._opened_at = self.clock.monotonic()
+                self.trips += 1
+                logger.warning(
+                    "circuit %s OPEN after %d consecutive failures "
+                    "(cool-down %.1fs)", self.name,
+                    self._consecutive_failures, self.reset_timeout_s)
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` under the breaker: refused with
+        :class:`CircuitOpenError` while OPEN, outcome recorded otherwise."""
+        if not self.allow():
+            raise CircuitOpenError(
+                f"circuit {self.name} is open",
+                retry_after=self.retry_after())
+        try:
+            out = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return out
+
+
+class NonFiniteGuard:
+    """Budget for skipped non-finite training steps.
+
+    The trainers detect non-finite gradients ON DEVICE and select the old
+    params/opt-state (the update is a no-op); this host-side guard counts
+    those skips, logs each one, fires ``on_step_skipped`` on the net's
+    listeners, and raises once more than ``budget`` steps were skipped —
+    a diverging run fails loudly instead of free-running on stale params.
+    """
+
+    def __init__(self, budget: int, net=None):
+        self.budget = int(budget)
+        self.net = net
+        self.skipped = 0
+
+    def step(self, ok, detail: str = "") -> None:
+        """Record one step's device-computed finiteness flag. ``detail``
+        qualifies partial skips (e.g. local-SGD, where only some replicas
+        suppressed their update)."""
+        if bool(ok):
+            return
+        self.skipped += 1
+        net = self.net
+        iteration = getattr(net, "iteration_count", self.skipped)
+        reason = ("non-finite gradients" + (f" ({detail})" if detail else ""))
+        logger.warning(
+            "%s at iteration %s — update suppressed (%d/%d budget)",
+            reason, iteration, self.skipped, self.budget)
+        for l in getattr(net, "listeners", []) or []:
+            hook = getattr(l, "on_step_skipped", None)
+            if hook is not None:
+                hook(net, iteration, reason)
+        if self.skipped > self.budget:
+            raise ResilienceError(
+                f"{self.skipped} training steps skipped for non-finite "
+                f"gradients (budget {self.budget}) — the run is diverging")

@@ -15,13 +15,17 @@ numpy archive — no pickling, no framework-version lock-in. Counters
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
+import os
 import zipfile
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+from . import faults as _faults
 
 Pytree = Any
 
@@ -29,7 +33,78 @@ _CONFIG_ENTRY = "configuration.json"
 _ARRAYS_ENTRY = "arrays.npz"
 _STATE_ENTRY = "training_state.json"
 _DTYPES_ENTRY = "dtypes.json"
+_CHECKSUMS_ENTRY = "checksums.json"
 _FORMAT_VERSION = 1
+
+
+class CheckpointInvalid(ValueError):
+    """The artifact at ``path`` is not a loadable checkpoint (truncated,
+    corrupt, or missing required entries)."""
+
+
+def _write_file_atomic(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` via same-directory temp + rename, so a
+    crash mid-write never leaves a partial file under the final name.
+    ``faults`` seam: ``"checkpoint.write"`` (payload: {path, data}) —
+    a scripted fault may raise before the write (clean failure) or
+    emulate a torn writer itself."""
+    _faults.check("checkpoint.write", {"path": path, "data": data})
+    d, base = os.path.split(os.path.abspath(path))
+    tmp = os.path.join(d, f".wip_{os.getpid()}_{base}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def verify_checkpoint(path: str) -> None:
+    """Validate a checkpoint artifact WITHOUT building the model: the file
+    is a readable zip, every required entry is present, the zip CRCs check
+    out, and (for artifacts that carry one) the sha256 manifest matches.
+    Raises :class:`CheckpointInvalid` with the reason otherwise."""
+    try:
+        if os.path.getsize(path) == 0:
+            raise CheckpointInvalid(f"{path}: empty file")
+        with zipfile.ZipFile(path, "r") as zf:
+            names = set(zf.namelist())
+            missing = ({_CONFIG_ENTRY, _ARRAYS_ENTRY, _STATE_ENTRY}
+                       - names)
+            if missing:
+                raise CheckpointInvalid(
+                    f"{path}: missing entries {sorted(missing)}")
+            if _CHECKSUMS_ENTRY in names:
+                # the sha256 manifest subsumes the per-entry CRC check
+                # (zf.read CRC-verifies as it streams), so the artifact is
+                # decompressed once here, not twice
+                manifest = json.loads(zf.read(_CHECKSUMS_ENTRY))
+                for name, want in manifest.items():
+                    if name not in names:
+                        raise CheckpointInvalid(
+                            f"{path}: manifest names missing entry {name!r}")
+                    got = hashlib.sha256(zf.read(name)).hexdigest()
+                    if got != want:
+                        raise CheckpointInvalid(
+                            f"{path}: sha256 mismatch for {name!r}")
+            else:
+                # legacy artifact without a manifest: zip CRCs only
+                bad = zf.testzip()
+                if bad is not None:
+                    raise CheckpointInvalid(
+                        f"{path}: CRC mismatch in {bad!r}")
+    except CheckpointInvalid:
+        raise
+    except Exception as e:
+        # BadZipFile, zlib.error from a corrupt deflate stream, OSError,
+        # manifest JSON errors, ... — all mean "not a loadable checkpoint"
+        raise CheckpointInvalid(f"{path}: {type(e).__name__}: {e}") from e
 
 
 def _npz_safe(arrays: Dict[str, np.ndarray]) -> Tuple[Dict[str, np.ndarray],
@@ -185,12 +260,25 @@ class ModelSerializer:
             "update_count": getattr(net, "_update_count", 0),
             "has_updater": bool(save_updater and net.updater_state is not None),
         }
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr(_CONFIG_ENTRY, net.conf.to_json())
-            zf.writestr(_ARRAYS_ENTRY, buf.getvalue())
-            zf.writestr(_STATE_ENTRY, json.dumps(training_state, indent=2))
-            if dtype_map:
-                zf.writestr(_DTYPES_ENTRY, json.dumps(dtype_map, indent=2))
+        entries = {_CONFIG_ENTRY: net.conf.to_json().encode("utf-8"),
+                   _ARRAYS_ENTRY: buf.getvalue(),
+                   _STATE_ENTRY: json.dumps(training_state,
+                                            indent=2).encode("utf-8")}
+        if dtype_map:
+            entries[_DTYPES_ENTRY] = json.dumps(dtype_map,
+                                                indent=2).encode("utf-8")
+        manifest = {name: hashlib.sha256(data).hexdigest()
+                    for name, data in entries.items()}
+        # one buffered artifact by design: the whole-blob payload is what
+        # lets the "checkpoint.write" fault seam script torn writes
+        # deterministically; getbuffer() hands the bytes over without a
+        # second copy
+        zbuf = io.BytesIO()
+        with zipfile.ZipFile(zbuf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for name, data in entries.items():
+                zf.writestr(name, data)
+            zf.writestr(_CHECKSUMS_ENTRY, json.dumps(manifest, indent=2))
+        _write_file_atomic(path, zbuf.getbuffer())
 
     @staticmethod
     def _read(path: str) -> Tuple[str, Dict[str, np.ndarray], dict]:

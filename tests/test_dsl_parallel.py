@@ -306,6 +306,18 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="l1/l2"):
             GraphPipelineTrainer(net, create_mesh({"pp": 2}))
 
+    def test_pp_moe_graph_raises(self):
+        """transformer_lm(moe_experts>0) names its MoE layers blk{i}_moe,
+        landing them inside the pipelined region — but run_vertices drops
+        vertex state, so the MoE aux_loss (load balancing) would silently
+        vanish from the objective. The trainer must refuse loudly and
+        point at ExpertParallelGraphTrainer instead (ADVICE r5 medium)."""
+        net = ComputationGraph(transformer_lm(
+            V, n_layers=2, d_model=16, n_heads=2, d_ff=32,
+            moe_experts=4, seed=5)).init()
+        with pytest.raises(ValueError, match="MoE"):
+            GraphPipelineTrainer(net, create_mesh({"pp": 2}))
+
     def test_pp_score_for_validates_batch(self):
         net = _net(n_layers=4)
         pp = GraphPipelineTrainer(net, create_mesh({"pp": 4}), n_micro=4)

@@ -179,6 +179,23 @@ class TestWord2Vec:
         with pytest.raises(ValueError, match="truncated"):
             WordVectorSerializer.load_google_model(str(raw))
 
+    def test_binary_write_rejects_whitespace_tokens(self, tmp_path):
+        """The C binary format's only word terminator is a space, so a
+        token containing whitespace desynchronizes every reader from that
+        word on — the writer must refuse instead of emitting a corrupt
+        file (ADVICE r5 low)."""
+        corpus = _synthetic_corpus(30)
+        # a tokenizer misconfiguration let a phrase through as one token
+        corpus.append(["bad token", "cat", "dog", "pet", "fur"] * 2)
+        sv = SequenceVectors(layer_size=8, epochs=1, seed=9).fit(corpus)
+        assert sv.vocab.has_token("bad token")
+        p = str(tmp_path / "vecs.bin")
+        with pytest.raises(ValueError, match="whitespace"):
+            WordVectorSerializer.write_word_vectors_binary(sv, p)
+        # the text format quotes nothing either, but ITS loader splits on
+        # the last dim fields, so the text writer keeps working
+        WordVectorSerializer.write_word_vectors(sv, str(tmp_path / "v.txt"))
+
     def test_subsampling_runs(self):
         corpus = _synthetic_corpus(50)
         sv = SequenceVectors(layer_size=8, sample=1e-3, epochs=1, seed=5)

@@ -64,6 +64,124 @@ class TestCheckpointRecovery:
         assert CheckpointRecovery(str(tmp_path)).restore() is None
 
 
+@pytest.mark.chaos
+class TestCorruptCheckpointFallback:
+    """Integrity validation on restore: flipped bytes, truncation, empty
+    files and torn writes never brick recovery — restore() falls back to
+    the newest checkpoint that still validates."""
+
+    def _two_checkpoints(self, tmp_path, rng):
+        net = _net()
+        x, y = _data(rng)
+        rec = CheckpointRecovery(str(tmp_path), keep=3)
+        net.fit(x, y, epochs=1)
+        first = rec.save(net)
+        net.fit(x, y, epochs=1)
+        second = rec.save(net)
+        return rec, first, second
+
+    def test_flipped_bytes_fall_back_to_previous(self, tmp_path, rng):
+        rec, first, second = self._two_checkpoints(tmp_path, rng)
+        blob = bytearray(open(second, "rb").read())
+        mid = len(blob) // 2
+        blob[mid] ^= 0xFF          # corrupt the arrays payload, size intact
+        blob[mid + 1] ^= 0xFF
+        with open(second, "wb") as f:
+            f.write(bytes(blob))
+        assert rec.latest() == second             # newest by name...
+        assert rec.latest_valid() == first        # ...but invalid by CRC
+        restored = rec.restore()
+        assert restored is not None
+        assert restored.epoch_count == 1          # the first checkpoint
+
+    def test_truncated_file_falls_back(self, tmp_path, rng):
+        rec, first, second = self._two_checkpoints(tmp_path, rng)
+        blob = open(second, "rb").read()
+        with open(second, "wb") as f:
+            f.write(blob[:len(blob) // 3])        # partial write
+        assert rec.latest_valid() == first
+        assert rec.restore().epoch_count == 1
+
+    def test_empty_file_falls_back(self, tmp_path, rng):
+        rec, first, second = self._two_checkpoints(tmp_path, rng)
+        open(second, "wb").close()
+        assert rec.latest_valid() == first
+        assert rec.restore().epoch_count == 1
+
+    def test_all_corrupt_returns_none(self, tmp_path, rng):
+        rec, first, second = self._two_checkpoints(tmp_path, rng)
+        for p in (first, second):
+            open(p, "wb").close()
+        assert rec.latest_valid() is None
+        assert rec.restore() is None
+
+    def test_verify_checkpoint_reports_reason(self, tmp_path, rng):
+        from deeplearning4j_tpu.util.serialization import (CheckpointInvalid,
+                                                           verify_checkpoint)
+        rec, first, second = self._two_checkpoints(tmp_path, rng)
+        verify_checkpoint(second)                  # intact: no raise
+        open(second, "wb").close()
+        with pytest.raises(CheckpointInvalid, match="empty"):
+            verify_checkpoint(second)
+
+    def test_faultplan_kills_write_midstream(self, tmp_path, rng):
+        """FaultPlan scripts the checkpoint writer dying mid-stream: the
+        save raises, no corrupt artifact appears under the final name, and
+        the next restore transparently serves the previous valid
+        checkpoint (the acceptance scenario — no sleeps, no monkeypatched
+        internals)."""
+        from deeplearning4j_tpu.util import faults
+
+        net = _net()
+        x, y = _data(rng)
+        rec = CheckpointRecovery(str(tmp_path), keep=3)
+        net.fit(x, y, epochs=1)
+        first = rec.save(net)
+        net.fit(x, y, epochs=1)
+
+        def torn_write(payload):
+            # emulate the writer crashing mid-stream: half the artifact
+            # lands on disk, then the process "dies" before the rename
+            with open(payload["path"], "wb") as f:
+                f.write(payload["data"][:len(payload["data"]) // 2])
+            raise IOError("writer killed mid-stream")
+
+        plan = faults.FaultPlan().fail("checkpoint.write", exc=torn_write)
+        with plan.active():
+            with pytest.raises(IOError, match="mid-stream"):
+                rec.save(net)
+        assert plan.triggered == [("checkpoint.write", 1)]
+        # a fresh recovery (new process) sweeps the debris and falls back
+        rec2 = CheckpointRecovery(str(tmp_path), keep=3)
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.startswith((".tmp_", ".wip_"))]
+        assert rec2.latest_valid("boundary") == first
+        restored = rec2.restore()
+        assert restored is not None
+        assert restored.epoch_count == 1
+
+    def test_clean_injected_failure_leaves_no_final_artifact(self, tmp_path,
+                                                            rng):
+        """A fault that raises BEFORE any bytes land (e.g. ENOSPC) leaves
+        the directory exactly as it was."""
+        from deeplearning4j_tpu.util import faults
+
+        net = _net()
+        x, y = _data(rng)
+        rec = CheckpointRecovery(str(tmp_path))
+        net.fit(x, y, epochs=1)
+        first = rec.save(net)
+        names_before = sorted(os.listdir(tmp_path))
+        net.fit(x, y, epochs=1)
+        plan = faults.FaultPlan().fail("checkpoint.write",
+                                       exc=IOError("disk full"))
+        with plan.active():
+            with pytest.raises(IOError, match="disk full"):
+                rec.save(net)
+        assert sorted(os.listdir(tmp_path)) == names_before
+        assert rec.restore().epoch_count == 1
+
+
 class TestRecoverableTrainer:
     def test_resume_matches_uninterrupted_run(self, tmp_path, rng):
         """Train 4 epochs straight vs 2 epochs + 'crash' + resume to 4 —

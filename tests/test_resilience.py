@@ -1,0 +1,422 @@
+"""Resilience-substrate tests: retry/backoff, circuit breaking, deadlines,
+deterministic fault injection, and the non-finite-step guard.
+
+Every failure path here is scripted — ManualClock instead of sleeps,
+FaultPlan instead of real network flakiness — so the chaos suite is as
+deterministic as the unit suite (hypothesis-style fault injection, not
+sleep-based chaos)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util import faults
+from deeplearning4j_tpu.util.resilience import (CircuitBreaker,
+                                                CircuitOpenError,
+                                                Deadline, DeadlineExceeded,
+                                                ManualClock, NonFiniteGuard,
+                                                ResilienceError,
+                                                RetriesExhausted,
+                                                RetryPolicy)
+
+pytestmark = pytest.mark.chaos
+
+
+class TestRetryPolicy:
+    def test_succeeds_without_retry(self):
+        clock = ManualClock()
+        policy = RetryPolicy(max_attempts=3, clock=clock)
+        assert policy.call(lambda: 42) == 42
+        assert clock.sleeps == []
+
+    def test_backoff_is_exponential_and_capped(self):
+        policy = RetryPolicy(initial_backoff=0.5, multiplier=2.0,
+                             max_backoff=3.0)
+        assert policy.backoff(0) == 0.0
+        assert policy.backoff(1) == 0.5
+        assert policy.backoff(2) == 1.0
+        assert policy.backoff(3) == 2.0
+        assert policy.backoff(4) == 3.0     # capped
+        assert policy.backoff(9) == 3.0
+
+    def test_retries_then_raises_exhausted(self):
+        clock = ManualClock()
+        policy = RetryPolicy(max_attempts=3, initial_backoff=1.0,
+                             clock=clock)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise IOError("down")
+
+        with pytest.raises(RetriesExhausted) as ei:
+            policy.call(flaky)
+        assert len(calls) == 3
+        assert clock.sleeps == [1.0, 2.0]
+        assert isinstance(ei.value.__cause__, IOError)
+
+    def test_recovers_mid_retry(self):
+        clock = ManualClock()
+        policy = RetryPolicy(max_attempts=5, initial_backoff=0.1,
+                             clock=clock)
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise IOError("down")
+            return "up"
+
+        assert policy.call(flaky) == "up"
+        assert state["n"] == 3
+
+    def test_deadline_bounds_the_whole_loop(self):
+        """With a 1s total deadline and 10 attempts of 1s backoff, the
+        loop stops sleeping once virtual time runs out."""
+        clock = ManualClock()
+        policy = RetryPolicy(max_attempts=10, initial_backoff=1.0,
+                             multiplier=1.0, deadline_s=2.5, clock=clock)
+        calls = []
+
+        def always_down():
+            calls.append(clock.monotonic())
+            raise IOError("down")
+
+        with pytest.raises(RetriesExhausted):
+            policy.call(always_down)
+        # attempts at t=0, 1, 2; the sleep toward t=3 crosses the deadline
+        assert len(calls) == 3
+
+
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        clock = ManualClock()
+        d = Deadline(5.0, clock)
+        assert d.remaining() == pytest.approx(5.0)
+        clock.advance(4.0)
+        assert not d.expired
+        clock.advance(1.5)
+        assert d.expired
+        assert d.remaining() == 0.0
+        with pytest.raises(DeadlineExceeded):
+            d.check("unit test")
+
+    def test_unbounded(self):
+        d = Deadline(None, ManualClock())
+        assert d.remaining() is None
+        assert not d.expired
+
+
+class TestCircuitBreaker:
+    def test_trips_open_after_consecutive_failures(self):
+        clock = ManualClock()
+        br = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                            clock=clock)
+
+        def boom():
+            raise IOError("down")
+
+        for _ in range(3):
+            with pytest.raises(IOError):
+                br.call(boom)
+        assert br.state == "open"
+        with pytest.raises(CircuitOpenError) as ei:
+            br.call(lambda: "unreachable")
+        assert ei.value.retry_after == pytest.approx(10.0)
+        assert br.rejected >= 1
+
+    def test_success_resets_the_failure_streak(self):
+        br = CircuitBreaker(failure_threshold=3, clock=ManualClock())
+        for _ in range(2):
+            br.record_failure()
+        br.record_success()
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed"   # never 3 consecutive
+
+    def test_half_open_probe_closes_on_success(self):
+        clock = ManualClock()
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                            clock=clock)
+        br.record_failure()
+        assert br.state == "open"
+        clock.advance(5.0)
+        assert br.state == "half_open"
+        assert br.call(lambda: "recovered") == "recovered"
+        assert br.state == "closed"
+
+    def test_half_open_admits_exactly_one_probe(self):
+        """After the cool-down only ONE caller gets through until the
+        probe's outcome lands — a recovering dependency never meets a
+        thundering herd."""
+        clock = ManualClock()
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                            clock=clock)
+        br.record_failure()
+        clock.advance(5.0)
+        assert br.allow() is True        # the probe
+        assert br.allow() is False       # herd is refused
+        assert br.allow() is False
+        br.record_success()
+        assert br.allow() is True        # closed again: everyone through
+        assert br.allow() is True
+
+    def test_half_open_probe_reopens_on_failure(self):
+        clock = ManualClock()
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                            clock=clock)
+        br.record_failure()
+        clock.advance(5.0)
+
+        def boom():
+            raise IOError("still down")
+
+        with pytest.raises(IOError):
+            br.call(boom)
+        assert br.state == "open"
+        assert br.trips == 2
+
+
+class TestFaultPlan:
+    def test_noop_without_plan(self):
+        faults.check("storage.post")    # must be silent
+
+    def test_scripted_nth_call_fails(self):
+        plan = faults.FaultPlan()
+        plan.fail_at("io.read", call=2, exc=IOError("flaky sector"))
+        with plan.active():
+            faults.check("io.read")
+            with pytest.raises(IOError, match="flaky sector"):
+                faults.check("io.read")
+            faults.check("io.read")
+        assert plan.calls("io.read") == 3
+        assert plan.triggered == [("io.read", 2)]
+
+    def test_fail_times_window(self):
+        plan = faults.FaultPlan().fail("net", times=2, after=1,
+                                       exc=ConnectionError)
+        with plan.active():
+            faults.check("net")
+            for _ in range(2):
+                with pytest.raises(ConnectionError):
+                    faults.check("net")
+            faults.check("net")
+
+    def test_callable_fault_receives_payload(self):
+        seen = {}
+
+        def torn(payload):
+            seen.update(payload)
+            raise IOError("torn")
+
+        plan = faults.FaultPlan().fail("checkpoint.write", exc=torn)
+        with plan.active():
+            with pytest.raises(IOError):
+                faults.check("checkpoint.write", {"path": "/x"})
+        assert seen["path"] == "/x"
+
+    def test_uninstall_restores_noop(self):
+        plan = faults.FaultPlan().always("site")
+        with plan.active():
+            with pytest.raises(faults.InjectedFault):
+                faults.check("site")
+        faults.check("site")
+
+    def test_double_install_rejected(self):
+        a, b = faults.FaultPlan(), faults.FaultPlan()
+        with a.active():
+            with pytest.raises(RuntimeError):
+                b.install()
+
+
+class TestRemoteRouterResilience:
+    """RemoteUIStatsStorageRouter under scripted outages: breaker trips
+    open after consecutive failures and recovers after the cool-down —
+    all via ManualClock + injected transport, no sockets, no sleeps."""
+
+    class _Record:
+        def to_json(self):
+            return "{\"x\": 1}"
+
+    def _router(self, transport, clock, **kw):
+        from deeplearning4j_tpu.storage.remote import \
+            RemoteUIStatsStorageRouter
+        return RemoteUIStatsStorageRouter(
+            "http://ui.invalid", transport=transport, clock=clock, **kw)
+
+    def test_breaker_trips_and_recovers(self):
+        from deeplearning4j_tpu.util.resilience import (CircuitBreaker,
+                                                        RetryPolicy)
+        clock = ManualClock()
+        outage = {"down": True, "posts": 0}
+
+        def transport(url, body, timeout):
+            if outage["down"]:
+                raise ConnectionError("ui unreachable")
+            outage["posts"] += 1
+
+        router = self._router(
+            transport, clock,
+            retry_policy=RetryPolicy(max_attempts=2, initial_backoff=0.1,
+                                     clock=clock),
+            breaker=CircuitBreaker(failure_threshold=3,
+                                   reset_timeout_s=30.0, clock=clock))
+        try:
+            # outage: enough records to trip the breaker (2 attempts each)
+            for _ in range(3):
+                router.put_update(self._Record())
+            router.flush()
+            assert router.breaker.state == "open"
+            assert router._posted == 0
+
+            # while open, records drop fast without touching the transport
+            before = outage["posts"]
+            router.put_update(self._Record())
+            router.flush()
+            assert outage["posts"] == before
+
+            # cool-down passes, the UI is back: the half-open probe closes
+            outage["down"] = False
+            clock.advance(30.0)
+            router.put_update(self._Record())
+            router.flush()
+            assert router.breaker.state == "closed"
+            assert router._posted == 1
+            assert outage["posts"] == 1
+        finally:
+            outage["down"] = False
+            router.close(timeout=2.0)
+
+    def test_happy_path_posts(self):
+        clock = ManualClock()
+        posted = []
+
+        def transport(url, body, timeout):
+            posted.append(body)
+
+        router = self._router(transport, clock)
+        try:
+            router.put_static_info(self._Record())
+            router.put_update(self._Record())
+            router.flush()
+            assert router._posted == 2
+        finally:
+            router.close(timeout=2.0)
+
+    def test_fault_plan_site_drives_the_default_transport(self):
+        """The 'storage.post' seam fires before any socket is touched, so
+        a scripted outage never needs a real listener."""
+        clock = ManualClock()
+        router = self._router(None, clock)   # default (urllib) transport
+        plan = faults.FaultPlan().always("storage.post",
+                                         exc=ConnectionError("scripted"))
+        try:
+            with plan.active():
+                router.put_update(self._Record())
+                router.flush()
+            assert router._posted == 0
+            assert router._dropped == 1
+            assert plan.calls("storage.post") >= 1
+        finally:
+            router.close(timeout=2.0)
+
+
+class TestNonFiniteGuard:
+    def _wrapper(self, budget):
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        conf = (NeuralNetConfiguration.builder().seed(7).updater("sgd")
+                .learning_rate(0.1).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        return net, ParallelWrapper(net, skip_nonfinite_budget=budget)
+
+    def _batch(self, rng, poison=False):
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        if poison:
+            x[0, 0] = np.nan
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        return x, y
+
+    def test_nan_step_is_skipped_params_unchanged(self, rng):
+        import jax
+        net, pw = self._wrapper(budget=3)
+        x, y = self._batch(rng)
+        pw.fit_batch(x, y)                     # healthy warm-up step
+        before = jax.device_get(net.params)
+        bad_x, bad_y = self._batch(rng, poison=True)
+        pw.fit_batch(bad_x, bad_y)             # NaN gradients: skipped
+        after = jax.device_get(net.params)
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        assert pw.nonfinite_guard.skipped == 1
+        # the very next healthy step trains normally
+        x2, y2 = self._batch(rng)
+        pw.fit_batch(x2, y2)
+        leaves_a = jax.tree_util.tree_leaves(jax.device_get(net.params))
+        leaves_b = jax.tree_util.tree_leaves(after)
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(leaves_a, leaves_b))
+
+    def test_budget_exhaustion_raises(self, rng):
+        net, pw = self._wrapper(budget=1)
+        with pytest.raises(ResilienceError, match="diverging"):
+            for _ in range(3):
+                bad = self._batch(rng, poison=True)
+                pw.fit_batch(*bad)
+        assert pw.nonfinite_guard.skipped == 2
+
+    def test_listener_hook_fires(self, rng):
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+        events = []
+
+        class Hook(TrainingListener):
+            def on_step_skipped(self, model, iteration, reason):
+                events.append((iteration, reason))
+
+        net, pw = self._wrapper(budget=5)
+        net.listeners.append(Hook())
+        pw.fit_batch(*self._batch(rng, poison=True))
+        assert len(events) == 1
+        assert "non-finite" in events[0][1]
+
+    def test_local_sgd_replica_skip(self, rng):
+        """Local-SGD mode: a NaN on ONE replica suppresses only that
+        replica's update (charged to the budget with replica detail); the
+        healthy replicas keep training and the next average re-syncs."""
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        conf = (NeuralNetConfiguration.builder().seed(7).updater("sgd")
+                .learning_rate(0.1).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        pw = ParallelWrapper(net, averaging_frequency=2,
+                             skip_nonfinite_budget=4)
+        x, y = self._batch(rng, poison=True)   # NaN in replica 0's shard
+        pw.fit_batch(x, y)
+        assert pw.nonfinite_guard.skipped == 1
+        pw.fit_batch(*self._batch(rng))        # healthy step + average
+        pw.finish()
+        import jax
+        assert all(np.isfinite(l).all() for l in
+                   jax.tree_util.tree_leaves(jax.device_get(net.params)))
+
+    def test_guard_unit_budget(self):
+        guard = NonFiniteGuard(2)
+        guard.step(True)
+        guard.step(False)
+        guard.step(False)
+        with pytest.raises(ResilienceError):
+            guard.step(False)

@@ -1,12 +1,16 @@
 """Inference-server tests (parity model: the reference's dl4j-streaming
 serve route — records in, predictions out, model swap — minus the Kafka
-brokers, per SCOPE.md)."""
+brokers, per SCOPE.md). The resilience scenarios (overload shedding,
+deadlines, breaker, drain) are scripted via blocking stub models,
+ManualClock and FaultPlan — deterministic, no sleep-based chaos."""
 
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -107,3 +111,237 @@ class TestInferenceServer:
             assert health["ok"]
         finally:
             server.stop()
+
+
+def _get_error(base, path, payload):
+    """POST expecting an HTTP error; returns (code, body, headers)."""
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+class _BlockingModel:
+    """Stub model whose output() blocks on an Event — lets tests hold the
+    batcher mid-batch deterministically (no sleeps)."""
+
+    def __init__(self, width=3):
+        self.width = width
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def output(self, x):
+        self.entered.set()
+        assert self.release.wait(timeout=30)
+        return np.zeros((x.shape[0], self.width), np.float32)
+
+
+class _FailingModel:
+    def output(self, x):
+        raise RuntimeError("model exploded")
+
+
+@pytest.mark.chaos
+class TestServingResilience:
+    def test_overload_returns_503_with_retry_after(self):
+        """Queue full → immediate 503 + Retry-After; queued requests still
+        complete once the batcher unblocks — no deadlock."""
+        model = _BlockingModel()
+        server = InferenceServer(model, port=0, max_batch=1,
+                                 batch_timeout_ms=1.0, max_queue=2)
+        base = f"http://127.0.0.1:{server.port}"
+        results = {}
+
+        def call(name):
+            results[name] = _get_error(
+                base, "/predict", {"inputs": [[0.0, 0.0, 0.0]]})
+
+        try:
+            # A is popped by the batcher and blocks inside the model
+            ta = threading.Thread(target=call, args=("a",))
+            ta.start()
+            assert model.entered.wait(timeout=10)
+            # B, C fill the bounded queue
+            tb = threading.Thread(target=call, args=("b",))
+            tc = threading.Thread(target=call, args=("c",))
+            tb.start(), tc.start()
+            deadline = threading.Event()
+            for _ in range(200):
+                if server._queue.qsize() >= 2:
+                    break
+                deadline.wait(0.01)
+            assert server._queue.qsize() == 2
+            # D overflows: shed NOW, not after a timeout
+            code, body, headers = _get_error(
+                base, "/predict", {"inputs": [[0.0, 0.0, 0.0]]})
+            assert code == 503
+            assert "overloaded" in body["error"]
+            assert "Retry-After" in headers
+            assert server.shed >= 1
+            # release the model: everything queued completes
+            model.release.set()
+            for t in (ta, tb, tc):
+                t.join(timeout=30)
+            for name in ("a", "b", "c"):
+                assert results[name][0] == 200, results[name]
+        finally:
+            model.release.set()
+            server.stop(drain=False)
+
+    def test_healthz_reports_queue_and_breaker(self):
+        model = _BlockingModel()
+        server = InferenceServer(model, port=0, max_batch=1, max_queue=7)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=5).read())
+            assert health["queue_depth"] == 0
+            assert health["queue_capacity"] == 7
+            assert health["breaker"] == "closed"
+            assert health["draining"] is False
+        finally:
+            model.release.set()
+            server.stop(drain=False)
+
+    def test_breaker_trips_on_model_failures_and_recovers(self, rng):
+        from deeplearning4j_tpu.util.resilience import (CircuitBreaker,
+                                                        ManualClock)
+        clock = ManualClock()
+        breaker = CircuitBreaker(failure_threshold=2, reset_timeout_s=60.0,
+                                 clock=clock, name="test-serving")
+        server = InferenceServer(_FailingModel(), port=0, max_batch=1,
+                                 breaker=breaker, clock=clock)
+        base = f"http://127.0.0.1:{server.port}"
+        x = [[0.0] * 5]
+        try:
+            # two failing batches trip the breaker
+            for _ in range(2):
+                code, body, _ = _get_error(base, "/predict", {"inputs": x})
+                assert code == 500
+            assert breaker.state == "open"
+            # while open: shed at admission with Retry-After ≈ cool-down
+            code, body, headers = _get_error(base, "/predict", {"inputs": x})
+            assert code == 503
+            assert "circuit" in body["error"]
+            assert float(headers["Retry-After"]) >= 1.0
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=5).read())
+            assert health["breaker"] == "open" and not health["ok"]
+            # model replaced, cool-down elapses → probe closes the circuit
+            server.set_model(_net())
+            clock.advance(60.0)
+            good = rng.normal(size=(1, 5)).astype(np.float32)
+            code, body, _ = _get_error(base, "/predict",
+                                       {"inputs": good.tolist()})
+            assert code == 200
+            assert breaker.state == "closed"
+        finally:
+            server.stop(drain=False)
+
+    def test_expired_request_answers_504_without_model_call(self):
+        """A request whose deadline passed while queued gets 504 and never
+        costs a model call (clock-driven, no real waiting)."""
+        from deeplearning4j_tpu.util.resilience import ManualClock
+        clock = ManualClock()
+        calls = []
+
+        class CountingModel(_BlockingModel):
+            def output(self, x):
+                calls.append(x.shape[0])
+                return super().output(x)
+
+        model = CountingModel()
+        server = InferenceServer(model, port=0, max_batch=1,
+                                 batch_timeout_ms=1.0,
+                                 request_timeout_s=5.0, clock=clock)
+        base = f"http://127.0.0.1:{server.port}"
+        results = {}
+
+        def call(name):
+            results[name] = _get_error(
+                base, "/predict", {"inputs": [[0.0, 0.0, 0.0]]})
+
+        try:
+            ta = threading.Thread(target=call, args=("a",))
+            ta.start()
+            assert model.entered.wait(timeout=10)
+            tb = threading.Thread(target=call, args=("b",))
+            tb.start()
+            for _ in range(200):
+                if server._queue.qsize() >= 1:
+                    break
+                threading.Event().wait(0.01)
+            # b sits in the queue; its deadline expires on the fake clock
+            clock.advance(10.0)
+            n_calls = len(calls)
+            model.release.set()
+            ta.join(timeout=30)
+            tb.join(timeout=30)
+            assert results["a"][0] == 200
+            assert results["b"][0] == 504
+            assert "deadline" in results["b"][1]["error"]
+            assert len(calls) == n_calls       # b never cost a model call
+        finally:
+            model.release.set()
+            server.stop(drain=False)
+
+    def test_graceful_drain_finishes_queued_work(self, rng):
+        net = _net()
+        server = InferenceServer(net, port=0, max_batch=8)
+        base = f"http://127.0.0.1:{server.port}"
+        xs = [rng.normal(size=(2, 5)).astype(np.float32) for _ in range(6)]
+        results = [None] * 6
+
+        def call(i):
+            results[i] = _get_error(base, "/predict",
+                                    {"inputs": xs[i].tolist()})
+
+        try:
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert server.drain(timeout=10)
+            # drained server refuses new work but answers health
+            code, body, headers = _get_error(
+                base, "/predict", {"inputs": xs[0].tolist()})
+            assert code == 503
+            assert "draining" in body["error"]
+            assert "Retry-After" in headers
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=5).read())
+            assert health["draining"] is True and not health["ok"]
+            for i in range(6):
+                assert results[i][0] == 200, results[i]
+        finally:
+            server.stop(drain=False)
+
+    def test_faultplan_scripts_an_inference_outage(self, rng):
+        """The 'serving.infer' seam fails exactly one batched model call:
+        that request answers 500, the next succeeds, and the breaker's
+        consecutive count resets."""
+        from deeplearning4j_tpu.util import faults
+        net = _net()
+        server = InferenceServer(net, port=0, max_batch=1)
+        base = f"http://127.0.0.1:{server.port}"
+        x = rng.normal(size=(1, 5)).astype(np.float32)
+        plan = faults.FaultPlan().fail_at("serving.infer", call=1,
+                                          exc=RuntimeError("chip fell over"))
+        try:
+            with plan.active():
+                code, body, _ = _get_error(base, "/predict",
+                                           {"inputs": x.tolist()})
+                assert code == 500
+                assert "chip fell over" in body["error"]
+                code, body, _ = _get_error(base, "/predict",
+                                           {"inputs": x.tolist()})
+                assert code == 200
+            assert server.breaker.state == "closed"
+        finally:
+            server.stop(drain=False)

@@ -335,6 +335,45 @@ class TestStreamingAttentionDecode:
             np.testing.assert_allclose(step, full[:, i], rtol=1e-4,
                                        atol=1e-5)
 
+    def test_cache_overflow_warns_instead_of_silent_clamp(self, rng):
+        """Feeding more TOTAL steps than max_cache_t overwrites the cache
+        tail and desyncs global positions — the host-side counter must
+        surface that (once) instead of degrading silently (ADVICE r5
+        low); clearing the state resets the tally."""
+        import warnings as _warnings
+        net = self._mln(max_cache_t=4)
+        x = rng.normal(size=(2, 3, 8)).astype(np.float32)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")      # silence is enforced
+            net.rnn_time_step(x)                 # 3 of 4 steps fed — fine
+        with pytest.warns(RuntimeWarning, match="max_cache_t"):
+            net.rnn_time_step(x)                 # 6 > 4: overflow
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")      # warn once, not per call
+            net.rnn_time_step(x[:, :1])
+        net.rnn_clear_previous_state()
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            net.rnn_time_step(x)                 # fresh tally after reset
+        assert net._rnn_steps_fed == 3
+
+    def test_graph_cache_overflow_warns(self, rng):
+        """Same contract for ComputationGraph streaming."""
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = transformer_lm(7, n_layers=1, d_model=16, n_heads=2,
+                              d_ff=32, seed=4)
+        for v in conf.vertices.values():
+            layer = getattr(v, "layer", None)
+            if layer is not None and hasattr(layer, "max_cache_t"):
+                layer.max_cache_t = 4
+        net = ComputationGraph(conf).init()
+        ids = np.random.default_rng(0).integers(0, 7, (2, 6))
+        x = np.eye(7, dtype=np.float32)[ids]
+        net.rnn_time_step(x[:, :3])
+        with pytest.warns(RuntimeWarning, match="max_cache_t"):
+            net.rnn_time_step(x[:, 3:])
+
     def test_no_cache_layers_unaffected(self, rng):
         """max_cache_t=None: output() and training behave exactly as
         before (the streaming branch never fires)."""
