@@ -448,6 +448,18 @@ def main() -> None:
     _run_config(out, "flash_attention", bench_flash_attention)
     _run_config(out, "transformer_lm", bench_transformer_lm)
 
+    # snapshot the process-default metrics registry into the payload so
+    # the perf trajectory carries whatever the run recorded (retry
+    # counters, batch-size + latency histograms from any instrumented
+    # path that defaulted to REGISTRY)
+    try:
+        from deeplearning4j_tpu.util import metrics as _metrics
+        snap = _metrics.REGISTRY.snapshot()
+        if snap:
+            out["metrics"] = snap
+    except Exception:
+        pass    # metrics must never erase a round's evidence
+
     if resnet_res is not None:
         out.update({
             "metric": "resnet50_train_throughput_per_chip",

@@ -26,6 +26,7 @@ from .listeners import (
     ScoreIterationListener,
     PerformanceListener,
     CollectScoresIterationListener,
+    MetricsListener,
     ParamAndGradientIterationListener,
     ComposableIterationListener,
 )
@@ -34,6 +35,6 @@ __all__ = [
     "Updater", "make_updater", "learning_rate_at", "normalize_gradients",
     "apply_updates", "TrainingListener", "ScoreIterationListener",
     "PerformanceListener", "CollectScoresIterationListener",
-    "ParamAndGradientIterationListener",
+    "MetricsListener", "ParamAndGradientIterationListener",
     "ComposableIterationListener",
 ]

@@ -163,6 +163,60 @@ class ComposableIterationListener(TrainingListener):
                 l.on_step_skipped(model, iteration, reason)
 
 
+class MetricsListener(TrainingListener):
+    """Bridge training events into a
+    :class:`~deeplearning4j_tpu.util.metrics.MetricsRegistry`: iteration
+    and epoch counters, a last-score gauge, an iteration-wall-time
+    histogram, and skipped-step counts from the resilience-guarded
+    trainers — the scrapeable twin of StatsListener (which feeds the UI).
+
+    Reading ``score`` forces a device sync (same caveat as
+    ScoreIterationListener); pass ``record_score=False`` to keep the
+    listener off the async dispatch path.
+    """
+
+    _ITER_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, registry=None, name: str = "net",
+                 record_score: bool = True):
+        from ..util import metrics as _metrics
+        reg = registry if registry is not None else _metrics.REGISTRY
+        self.registry = reg
+        self.name = name
+        self.record_score = record_score
+        self._iterations = reg.counter(
+            "training_iterations_total", "Training iterations completed",
+            ("model",))
+        self._epochs = reg.counter(
+            "training_epochs_total", "Training epochs completed", ("model",))
+        self._skipped = reg.counter(
+            "training_steps_skipped_total",
+            "Steps skipped by the non-finite guard", ("model",))
+        self._score = reg.gauge(
+            "training_score", "Score at the latest iteration", ("model",))
+        self._iter_time = reg.histogram(
+            "training_iteration_seconds",
+            "Wall time between consecutive iterations", ("model",),
+            buckets=self._ITER_BUCKETS)
+        self._last_time: Optional[float] = None
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        self._iterations.inc(model=self.name)
+        if self._last_time is not None:
+            self._iter_time.observe(now - self._last_time, model=self.name)
+        self._last_time = now
+        if self.record_score:
+            self._score.set(float(score), model=self.name)
+
+    def on_epoch_end(self, model, epoch):
+        self._epochs.inc(model=self.name)
+
+    def on_step_skipped(self, model, iteration, reason):
+        self._skipped.inc(model=self.name)
+
+
 class ParamAndGradientIterationListener(TrainingListener):
     """Log per-layer parameter and update magnitudes every N iterations
     (parity: ``ParamAndGradientIterationListener.java``).

@@ -39,13 +39,29 @@ class TrainingStats:
     durations are true device times, not dispatch times.
     """
 
-    def __init__(self, blocking: bool = False):
+    def __init__(self, blocking: bool = False, registry=None):
         self.blocking = blocking
         self.events: List[PhaseEvent] = []
         self._origin = time.perf_counter()
+        # optional mirror into the metrics plane: each phase event also
+        # lands in training_phase_seconds{phase=...} so distributed phase
+        # timings ride the same scrape as serving/resilience metrics
+        self._phase_hist = None
+        if registry is not None:
+            self._phase_hist = registry.histogram(
+                "training_phase_seconds",
+                "Distributed-training phase durations", ("phase",),
+                buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                         0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
 
     def _now_ms(self) -> float:
         return (time.perf_counter() - self._origin) * 1000.0
+
+    def _add(self, event: PhaseEvent) -> None:
+        self.events.append(event)
+        if self._phase_hist is not None:
+            self._phase_hist.observe(event.duration_ms / 1000.0,
+                                     phase=event.phase)
 
     @contextmanager
     def time_phase(self, phase: str, result_holder: Optional[list] = None):
@@ -61,10 +77,10 @@ class TrainingStats:
                 for leaf in jax.tree_util.tree_leaves(result_holder):
                     if hasattr(leaf, "block_until_ready"):
                         leaf.block_until_ready()
-            self.events.append(PhaseEvent(phase, t0, self._now_ms() - t0))
+            self._add(PhaseEvent(phase, t0, self._now_ms() - t0))
 
     def record(self, phase: str, start_ms: float, duration_ms: float) -> None:
-        self.events.append(PhaseEvent(phase, start_ms, duration_ms))
+        self._add(PhaseEvent(phase, start_ms, duration_ms))
 
     # ------------------------------------------------------------------
     # summaries (parity: CommonSparkTrainingStats getValue/statsAsString)

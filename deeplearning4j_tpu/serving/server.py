@@ -5,6 +5,7 @@ Endpoints:
   GET  /healthz   {"ok": true, "model": "...", "served": N,
                    "queue_depth": n, "queue_capacity": n,
                    "breaker": "closed|open|half_open", "draining": bool}
+  GET  /metrics   Prometheus text exposition of this server's registry
   POST /model     swap the served model from a checkpoint zip path
                   {"path": "/path/to/model.zip"}
 
@@ -30,11 +31,26 @@ Resilience (rides :mod:`deeplearning4j_tpu.util.resilience`):
   everything already queued, then shuts down — no request is dropped
   mid-flight on a planned restart.
 
+Observability (rides :mod:`deeplearning4j_tpu.util.metrics` /
+:mod:`~deeplearning4j_tpu.util.tracing`):
+
+- ``GET /metrics``: request latency histogram split by phase
+  (queue_wait / batch_assembly / model_call), responses by code, shed
+  by reason, deadline expiries, batch-size histogram, live gauges for
+  queue depth / pending requests / breaker state, and breaker state
+  transitions (via the breaker's ``on_transition`` hook).
+- With a :class:`~deeplearning4j_tpu.util.tracing.Tracer` attached,
+  every predict produces parented spans: ``predict`` → ``queue`` (time
+  in the bounded queue) and ``batch`` → ``model`` (the coalesced call),
+  and the ``serving.infer`` fault seam records which span a scripted
+  fault landed in.
+
 Fault seam: ``"serving.infer"`` around the batched model call.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import queue
 import threading
@@ -45,11 +61,14 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ..util import faults as _faults
-from ..util.resilience import SYSTEM_CLOCK, CircuitBreaker, Clock, Deadline
+from ..util import metrics as _metrics
+from ..util.resilience import (SYSTEM_CLOCK, STATE_VALUES, CircuitBreaker,
+                               Clock, Deadline)
 
 
 class _Pending:
-    __slots__ = ("x", "event", "result", "error", "code", "deadline")
+    __slots__ = ("x", "event", "result", "error", "code", "deadline",
+                 "enqueued_at", "span", "queue_span")
 
     def __init__(self, x: np.ndarray, deadline: Deadline):
         self.x = x
@@ -58,6 +77,9 @@ class _Pending:
         self.error: Optional[str] = None
         self.code: int = 500
         self.deadline = deadline
+        self.enqueued_at = time.perf_counter()
+        self.span = None          # request-root tracing span
+        self.queue_span = None    # child span covering queue wait
 
 
 class InferenceServer:
@@ -69,18 +91,26 @@ class InferenceServer:
                  max_queue: int = 256,
                  request_timeout_s: float = 30.0,
                  breaker: Optional[CircuitBreaker] = None,
-                 clock: Clock = SYSTEM_CLOCK):
+                 clock: Clock = SYSTEM_CLOCK,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 tracer=None):
         self._model = model
         self.max_batch = int(max_batch)
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
         self.pad_to_buckets = pad_to_buckets
         self.request_timeout_s = float(request_timeout_s)
         self.clock = clock
+        self.tracer = tracer
+        # per-server registry by default so two servers in one process
+        # (tests, blue/green) don't blur each other's numbers; pass
+        # metrics.REGISTRY to aggregate into the process default
+        self.registry = registry if registry is not None \
+            else _metrics.MetricsRegistry()
+        self._init_metrics()
         self.breaker = breaker or CircuitBreaker(
             failure_threshold=3, reset_timeout_s=5.0, clock=clock,
             name="serving-model")
-        self.served = 0
-        self.shed = 0            # requests answered 503 (queue full/draining)
+        self._chain_breaker_hook()
         self._queue: "queue.Queue[_Pending]" = queue.Queue(
             maxsize=int(max_queue))
         self._lock = threading.Lock()
@@ -90,6 +120,10 @@ class InferenceServer:
         # queue emptiness (an item leaves the queue before it is answered)
         self._pending = 0
         self._pending_lock = threading.Lock()
+        self._m_queue_depth.set_function(lambda: float(self._queue.qsize()))
+        self._m_pending.set_function(lambda: float(self._pending))
+        self._m_breaker_state.set_function(
+            lambda: STATE_VALUES.get(self.breaker.state, -1.0))
         self._batcher = threading.Thread(target=self._batch_loop, daemon=True)
         self._batcher.start()
 
@@ -101,6 +135,7 @@ class InferenceServer:
 
             def _json(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
+                outer._m_responses.inc(code=str(code))
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -112,6 +147,9 @@ class InferenceServer:
             def do_GET(self):
                 if self.path == "/healthz":
                     self._json(outer._health())
+                elif self.path == "/metrics":
+                    _metrics.write_exposition(self, outer.registry)
+                    outer._m_responses.inc(code="200")
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -151,6 +189,76 @@ class InferenceServer:
         self._serve_thread.start()
 
     # ------------------------------------------------------------------
+    # metrics
+    # ------------------------------------------------------------------
+
+    def _init_metrics(self) -> None:
+        reg = self.registry
+        self._m_responses = reg.counter(
+            "serving_responses_total", "HTTP responses by status code",
+            ("code",))
+        self._m_shed = reg.counter(
+            "serving_shed_total",
+            "Predict requests shed with 503 before reaching the model",
+            ("reason",))
+        self._m_deadline_expired = reg.counter(
+            "serving_deadline_expired_total",
+            "Queued requests answered 504 after their deadline passed")
+        self._m_served = reg.counter(
+            "serving_examples_served_total",
+            "Examples answered 200 through the batched model call")
+        # a fixed powers-of-two ladder (the jit bucket shape), NOT derived
+        # from max_batch: servers with different max_batch can then share
+        # one registry without a bucket-mismatch error
+        self._m_batch_size = reg.histogram(
+            "serving_batch_size", "Examples coalesced per model call",
+            buckets=[float(1 << i) for i in range(11)])   # 1..1024
+        self._m_latency = reg.histogram(
+            "serving_request_latency_seconds",
+            "Per-phase request latency: time in the bounded queue "
+            "(queue_wait), coalescing window (batch_assembly), and the "
+            "batched model call (model_call)", ("phase",))
+
+        self._m_queue_depth = reg.gauge(
+            "serving_queue_depth", "Requests waiting in the bounded queue")
+        self._m_pending = reg.gauge(
+            "serving_pending_requests", "Admitted but unanswered requests")
+        self._m_breaker_state = reg.gauge(
+            "serving_breaker_state",
+            "Model circuit breaker state (0=closed, 1=half_open, 2=open)")
+
+    def _chain_breaker_hook(self) -> None:
+        """Record breaker transitions into this server's registry, on top
+        of any hook the injected breaker already carries."""
+        from ..util.resilience import metrics_transition_hook
+        record = metrics_transition_hook(self.registry)
+        prior = self.breaker.on_transition
+
+        def hook(name: str, old: str, new: str) -> None:
+            record(name, old, new)
+            if prior is not None:
+                prior(name, old, new)
+
+        self.breaker.on_transition = hook
+
+    # back-compat: the pre-metrics bare-int attributes, now read-only
+    # views over the registry (the racy ``+= 1`` writers are gone)
+
+    @property
+    def served(self) -> int:
+        """Examples answered 200 (back-compat for /healthz and tests)."""
+        return int(self._m_served.value())
+
+    @property
+    def shed(self) -> int:
+        """Requests shed for load (queue full / draining) — the pre-metrics
+        semantics. Breaker rejections are NOT load shedding; they appear
+        only as serving_shed_total{reason="breaker_open"} and
+        ``breaker.rejected``."""
+        return int(self._m_shed.value(reason="queue_full")
+                   + self._m_shed.value(reason="draining"))
+
+    # ------------------------------------------------------------------
 
     def _health(self) -> dict:
         return {"ok": not self._draining
@@ -168,13 +276,18 @@ class InferenceServer:
                             int, Optional[float]]:
         """Returns (outputs, error, http_code, retry_after_s)."""
         if self._draining or self._stop.is_set():
-            self.shed += 1
+            self._m_shed.inc(reason="draining")
             return None, "server is draining", 503, 1.0
         if not self.breaker.allow():
+            self._m_shed.inc(reason="breaker_open")
             retry = max(1.0, self.breaker.retry_after())
             return (None, "model circuit open (failing upstream)", 503,
                     retry)
         p = _Pending(x, Deadline(self.request_timeout_s, self.clock))
+        if self.tracer is not None:
+            p.span = self.tracer.start(
+                "predict", attributes={"examples": int(x.shape[0])})
+            p.queue_span = self.tracer.start("queue", parent=p.span)
         with self._pending_lock:
             self._pending += 1
         try:
@@ -184,7 +297,8 @@ class InferenceServer:
             # unbounded queue that times every client out later
             with self._pending_lock:
                 self._pending -= 1
-            self.shed += 1
+            self._m_shed.inc(reason="queue_full")
+            self._end_spans(p, "shed")
             return (None, "server overloaded (queue full)", 503,
                     max(1.0, self.batch_timeout_s))
         p.event.wait(timeout=self.request_timeout_s + 1.0)
@@ -194,11 +308,35 @@ class InferenceServer:
             return None, "inference timeout", 504, None
         return p.result, None, 200, None
 
+    @staticmethod
+    def _end_spans(p: _Pending, status: Optional[str] = None) -> None:
+        if p.queue_span is not None:
+            p.queue_span.end(status)
+        if p.span is not None:
+            p.span.end(status)
+
     def _finish(self, p: _Pending) -> None:
         """Answer a pending request (exactly once per admitted request)."""
+        if p.span is not None:
+            # an answer arriving after the deadline was 504'd to the
+            # client — the trace must not claim a clean 200
+            late = p.error is None and p.deadline.expired
+            p.span.set_attribute("code", p.code if p.error is not None
+                                 else 200)
+            if late:
+                p.span.set_attribute("late", True)
+            self._end_spans(p, "error" if p.error is not None
+                            else ("late" if late else None))
         p.event.set()
         with self._pending_lock:
             self._pending -= 1
+
+    def _dequeued(self, p: _Pending) -> None:
+        """Bookkeeping when the batcher pops a request off the queue."""
+        self._m_latency.observe(time.perf_counter() - p.enqueued_at,
+                                phase="queue_wait")
+        if p.queue_span is not None:
+            p.queue_span.end()
 
     def _batch_loop(self) -> None:
         while not self._stop.is_set():
@@ -206,9 +344,11 @@ class InferenceServer:
                 first = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
+            self._dequeued(first)
+            assembly_t0 = time.perf_counter()
             batch = [first]
             n = first.x.shape[0]
-            deadline = time.perf_counter() + self.batch_timeout_s
+            deadline = assembly_t0 + self.batch_timeout_s
             while n < self.max_batch:
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
@@ -217,8 +357,11 @@ class InferenceServer:
                     p = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
+                self._dequeued(p)
                 batch.append(p)
                 n += p.x.shape[0]
+            self._m_latency.observe(time.perf_counter() - assembly_t0,
+                                    phase="batch_assembly")
             # expired requests: their client already gave up — answer
             # 504 and spend the model call on the live ones only
             live = []
@@ -226,6 +369,7 @@ class InferenceServer:
                 if p.deadline.expired:
                     p.error = "request deadline exceeded"
                     p.code = 504
+                    self._m_deadline_expired.inc()
                     self._finish(p)
                 else:
                     live.append(p)
@@ -239,27 +383,53 @@ class InferenceServer:
         return min(b, max(self.max_batch, n))
 
     def _run_batch(self, batch: List[_Pending]) -> None:
+        batch_span = None
+        model_t0 = None
+        if self.tracer is not None:
+            batch_span = self.tracer.start(
+                "batch", parent=batch[0].span,
+                attributes={"requests": len(batch)})
         try:
             x = np.concatenate([p.x for p in batch], axis=0)
             n = x.shape[0]
+            if batch_span is not None:
+                batch_span.set_attribute("examples", n)
+            self._m_batch_size.observe(float(n))
             if self.pad_to_buckets:
                 b = self._bucket(n)
                 if b > n:  # pad to a power-of-two bucket: one jit cache
                     x = np.concatenate(
                         [x, np.zeros((b - n,) + x.shape[1:], x.dtype)])
-            with self._lock:
+            model_t0 = time.perf_counter()
+            # span() (not start) so the serving.infer seam sees the model
+            # span as this thread's active span
+            model_ctx = (self.tracer.span("model", parent=batch_span)
+                         if self.tracer is not None
+                         else contextlib.nullcontext())
+            with self._lock, model_ctx:
                 _faults.check("serving.infer", {"batch": n})
                 out = np.asarray(self._model.output(x))[:n]
+            self._m_latency.observe(time.perf_counter() - model_t0,
+                                    phase="model_call")
             ofs = 0
             for p in batch:
                 k = p.x.shape[0]
                 p.result = out[ofs:ofs + k]
                 ofs += k
                 self._finish(p)
-            self.served += n
+            self._m_served.inc(n)
             self.breaker.record_success()
+            if batch_span is not None:
+                batch_span.end()
         except Exception as e:
+            # a failing model call still has a latency — the histogram
+            # must not go blind during the exact window the breaker trips
+            if model_t0 is not None:
+                self._m_latency.observe(time.perf_counter() - model_t0,
+                                        phase="model_call")
             self.breaker.record_failure()
+            if batch_span is not None:
+                batch_span.end("error")
             for p in batch:
                 p.error = f"{type(e).__name__}: {e}"
                 p.code = 500

@@ -9,8 +9,8 @@ impls ``InMemoryStatsStorage`` and the MapDB-backed store (here: JSONL file).
 from .remote import RemoteUIStatsStorageRouter
 from .stats_storage import (FileStatsStorage, InMemoryStatsStorage,
                             Persistable, StatsStorage, StatsStorageListener,
-                            StatsStorageRouter)
+                            StatsStorageMetricsListener, StatsStorageRouter)
 
 __all__ = ["StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
            "Persistable", "StatsStorageRouter", "StatsStorageListener",
-           "RemoteUIStatsStorageRouter"]
+           "StatsStorageMetricsListener", "RemoteUIStatsStorageRouter"]

@@ -50,7 +50,8 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
         self.clock = clock
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=int(max_retries), initial_backoff=0.2,
-            max_backoff=5.0, deadline_s=30.0, clock=clock)
+            max_backoff=5.0, deadline_s=30.0, clock=clock,
+            name="remote-ui")
         self.breaker = breaker or CircuitBreaker(
             failure_threshold=5, reset_timeout_s=30.0, clock=clock,
             name=f"remote-ui[{self.url}]")
@@ -106,6 +107,7 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
                 continue
             self.breaker.record_success()
             return True
+        self.retry_policy.record_give_up()
         return False
 
     def _drain(self) -> None:

@@ -95,7 +95,9 @@ class StatsStorage(StatsStorageRouter):
 
     def get_static_info(self, session_id: str, type_id: str,
                         worker_id: str) -> Optional[Persistable]:
-        return self._static.get((session_id, type_id, worker_id))
+        # under the lock: writer threads mutate _static concurrently
+        with self._lock:
+            return self._static.get((session_id, type_id, worker_id))
 
     def get_all_updates_after(self, session_id: str, type_id: str,
                               worker_id: str, timestamp: float
@@ -106,15 +108,19 @@ class StatsStorage(StatsStorageRouter):
 
     def get_latest_update(self, session_id: str, type_id: str,
                           worker_id: str) -> Optional[Persistable]:
-        recs = self._updates.get((session_id, type_id, worker_id), [])
-        return recs[-1] if recs else None
+        with self._lock:
+            recs = self._updates.get((session_id, type_id, worker_id), [])
+            return recs[-1] if recs else None
 
     # -- subscribe --
     def register_listener(self, listener: StatsStorageListener) -> None:
-        self._listeners.append(listener)
+        with self._lock:
+            self._listeners.append(listener)
 
     def _notify(self, event: str, record: Persistable) -> None:
-        for l in self._listeners:
+        with self._lock:
+            listeners = list(self._listeners)
+        for l in listeners:
             l.notify(event, record)
 
     # -- persistence hook (overridden by FileStatsStorage) --
@@ -128,7 +134,12 @@ class InMemoryStatsStorage(StatsStorage):
 
 class FileStatsStorage(StatsStorage):
     """Append-only JSONL persistence, reloaded on open (parity: the
-    reference's MapDB-backed ``FileStatsStorage``)."""
+    reference's MapDB-backed ``FileStatsStorage``). Usable as a context
+    manager so the append handle cannot leak::
+
+        with FileStatsStorage(path) as st:
+            st.put_update(...)
+    """
 
     def __init__(self, path: str):
         super().__init__()
@@ -149,9 +160,34 @@ class FileStatsStorage(StatsStorage):
         self._f = open(path, "a")
 
     def _persist(self, kind: str, record: Persistable) -> None:
+        if self._f.closed:
+            raise ValueError(f"FileStatsStorage({self.path!r}) is closed")
         self._f.write(json.dumps(
             {"kind": kind, "record": dataclasses.asdict(record)}) + "\n")
         self._f.flush()
 
     def close(self) -> None:
         self._f.close()
+
+    def __enter__(self) -> "FileStatsStorage":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class StatsStorageMetricsListener(StatsStorageListener):
+    """Counts records routed through a storage, per event kind and
+    type_id — ``stats_records_total{event,type_id}`` answers "is the
+    remote run still posting?" from one scrape instead of a UI visit."""
+
+    def __init__(self, registry=None):
+        from ..util import metrics as _metrics
+        reg = registry if registry is not None else _metrics.REGISTRY
+        self.records = reg.counter(
+            "stats_records_total", "Stats records routed into storage",
+            ("event", "type_id"))
+
+    def notify(self, event: str, record: Persistable) -> None:
+        self.records.inc(event=event, type_id=record.type_id)

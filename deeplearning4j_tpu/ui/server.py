@@ -18,6 +18,9 @@ Endpoints:
   GET  /api/activations?sid= latest conv activation grid
                             (parity: ConvolutionalListenerModule)
   GET  /api/tsne?sid=       stored t-SNE embedding (parity: TsneModule)
+  GET  /metrics             Prometheus text exposition of the attached
+                            metrics registry (process default unless one
+                            is passed to UIServer)
   POST /api/tsne            upload coords, or raw vectors to embed
   POST /api/remote          receive stats records POSTed by
                             RemoteUIStatsStorageRouter from other hosts
@@ -33,6 +36,7 @@ from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..storage.stats_storage import StatsStorage
+from ..util import metrics as _metrics
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu training UI</title>
@@ -234,6 +238,7 @@ init();
 
 class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None
+    registry: Optional[_metrics.MetricsRegistry] = None
 
     def log_message(self, *args):  # silence request logging
         pass
@@ -257,6 +262,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif url.path == "/metrics":
+            # Prometheus exposition: the dashboard process's registry
+            # (training listeners, storage routing, phase timings)
+            _metrics.write_exposition(self, self.registry
+                                      or _metrics.REGISTRY)
         elif url.path == "/api/sessions":
             self._json(st.list_session_ids())
         elif url.path == "/api/overview":
@@ -432,8 +442,13 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000,
+                 registry: Optional[_metrics.MetricsRegistry] = None):
         self.port = port
+        # default: the process registry, so a dashboard scrape sees the
+        # training process's MetricsListener / storage-routing counters
+        self.registry = registry if registry is not None \
+            else _metrics.REGISTRY
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.storage: Optional[StatsStorage] = None
@@ -447,7 +462,8 @@ class UIServer:
     def attach(self, storage: StatsStorage) -> "UIServer":
         self.storage = storage
         if self._httpd is None:
-            handler = type("BoundHandler", (_Handler,), {"storage": storage})
+            handler = type("BoundHandler", (_Handler,),
+                           {"storage": storage, "registry": self.registry})
             self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
             self.port = self._httpd.server_address[1]
             self._thread = threading.Thread(

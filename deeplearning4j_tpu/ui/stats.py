@@ -64,7 +64,11 @@ class StatsListener(TrainingListener):
         self.worker_id = worker_id
         self.collect_histograms = collect_histograms
         self.histogram_frequency = max(1, int(histogram_frequency))
+        # time/iteration of the last COLLECTED iteration: per-iteration
+        # duration is (now - then) / iterations-elapsed. (Touching this
+        # every iteration_done under-reported iteration_ms by ~frequency×.)
         self._last_time: Optional[float] = None
+        self._last_iteration: Optional[int] = None
         self._static_posted = False
         self._prev_params: Optional[Dict[str, np.ndarray]] = None
 
@@ -77,12 +81,15 @@ class StatsListener(TrainingListener):
         if not self._static_posted:
             self._post_static(model)
         if iteration % self.frequency:
-            self._last_time = time.perf_counter()
             return
         now = time.perf_counter()
-        duration_ms = (None if self._last_time is None
-                       else 1000.0 * (now - self._last_time) / self.frequency)
+        if self._last_time is None:
+            duration_ms = None
+        else:
+            elapsed_iters = max(1, iteration - self._last_iteration)
+            duration_ms = 1000.0 * (now - self._last_time) / elapsed_iters
         self._last_time = now
+        self._last_iteration = iteration
         data: Dict[str, Any] = {
             "iteration": int(iteration),
             "score": float(score),

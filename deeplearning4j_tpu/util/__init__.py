@@ -7,6 +7,11 @@ Parity: reference ``deeplearning4j-nn/.../util/`` — chiefly
 from .serialization import ModelSerializer, load_model, save_model
 from .recovery import CheckpointRecovery, RecoverableTrainer
 from . import profiling
+from . import metrics
+from . import tracing
+from .metrics import REGISTRY, MetricsRegistry
+from .tracing import Tracer
 
 __all__ = ["ModelSerializer", "save_model", "load_model",
-           "CheckpointRecovery", "RecoverableTrainer", "profiling"]
+           "CheckpointRecovery", "RecoverableTrainer", "profiling",
+           "metrics", "tracing", "REGISTRY", "MetricsRegistry", "Tracer"]

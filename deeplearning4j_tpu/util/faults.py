@@ -25,6 +25,11 @@ Usage::
 
 A fault may also be a callable hook (e.g. to truncate bytes before
 raising — a torn write); it receives the payload the site passed.
+
+Each triggered fault is recorded in ``plan.triggered`` (site, call#) and
+``plan.trigger_context`` (site, call, payload, plus seam context from
+registered providers — :mod:`deeplearning4j_tpu.util.tracing` stamps the
+active span, so tests can assert which span a fault landed in).
 """
 
 from __future__ import annotations
@@ -36,6 +41,28 @@ Fault = Union[BaseException, Callable[[Any], None]]
 
 _lock = threading.Lock()
 _active: Optional["FaultPlan"] = None
+
+# Seam-context providers: callables returning a dict merged into the
+# context recorded when a fault triggers. util/tracing.py registers one
+# that stamps the active span, so a chaos test can assert WHICH span a
+# scripted fault landed in.
+_context_providers: list = []
+
+
+def add_context_provider(fn: Callable[[], dict]) -> None:
+    if fn not in _context_providers:
+        _context_providers.append(fn)
+
+
+def seam_context() -> dict:
+    """The merged context of all registered providers (empty when none)."""
+    ctx: dict = {}
+    for fn in list(_context_providers):
+        try:
+            ctx.update(fn() or {})
+        except Exception:
+            pass            # a broken provider must never mask the seam
+    return ctx
 
 
 class _Rule:
@@ -63,6 +90,9 @@ class FaultPlan:
         self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
         self.triggered: List[tuple] = []   # (site, call#) audit trail
+        # one dict per triggered fault: site, call, payload, plus seam
+        # context (e.g. the active tracing span) captured at the hit
+        self.trigger_context: List[dict] = []
 
     # -- scripting --
 
@@ -104,6 +134,9 @@ class FaultPlan:
                          if r.matches(n)), None)
             if rule is not None:
                 self.triggered.append((site, n))
+                self.trigger_context.append(
+                    {"site": site, "call": n, "payload": payload,
+                     **seam_context()})
         if rule is None:
             return
         fault = rule.fault

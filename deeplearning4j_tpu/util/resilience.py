@@ -127,7 +127,8 @@ class RetryPolicy:
                  multiplier: float = 2.0,
                  deadline_s: Optional[float] = None,
                  retry_on: Tuple[Type[BaseException], ...] = (Exception,),
-                 clock: Clock = SYSTEM_CLOCK):
+                 clock: Clock = SYSTEM_CLOCK,
+                 name: str = "retry", registry=None):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if multiplier < 1.0:
@@ -139,6 +140,17 @@ class RetryPolicy:
         self.deadline_s = deadline_s
         self.retry_on = retry_on
         self.clock = clock
+        self.name = name
+        # attempt / give-up counters, labeled by policy name so one
+        # scrape separates "remote UI flapping" from "checkpoint flapping"
+        from . import metrics as _metrics
+        reg = registry if registry is not None else _metrics.REGISTRY
+        self._attempts_counter = reg.counter(
+            "retry_attempts_total", "Attempts started under a RetryPolicy",
+            ("policy",))
+        self._give_ups_counter = reg.counter(
+            "retry_give_ups_total",
+            "Retry loops that exhausted attempts or deadline", ("policy",))
 
     def backoff(self, attempt: int) -> float:
         """Sleep before attempt ``attempt`` (0-based; attempt 0 has none)."""
@@ -160,7 +172,14 @@ class RetryPolicy:
                     # — give up now instead of sleeping toward nothing
                     return
                 self.clock.sleep(wait)
+            self._attempts_counter.inc(policy=self.name)
             yield attempt
+
+    def record_give_up(self) -> None:
+        """Count one exhausted retry loop. ``call()`` does this itself;
+        callers driving ``attempts()`` by hand (e.g. the remote stats
+        router) call it when their loop ends without success."""
+        self._give_ups_counter.inc(policy=self.name)
 
     def call(self, fn: Callable, *args, **kwargs):
         last: Optional[BaseException] = None
@@ -171,6 +190,7 @@ class RetryPolicy:
                 return fn(*args, **kwargs)
             except self.retry_on as e:
                 last = e
+        self.record_give_up()
         cut = ("" if ran == self.max_attempts
                else f" (deadline cut the loop short of {self.max_attempts})")
         raise RetriesExhausted(
@@ -191,32 +211,63 @@ class CircuitBreaker:
 
     def __init__(self, *, failure_threshold: int = 5,
                  reset_timeout_s: float = 30.0,
-                 clock: Clock = SYSTEM_CLOCK, name: str = "breaker"):
+                 clock: Clock = SYSTEM_CLOCK, name: str = "breaker",
+                 on_transition: Optional[Callable[[str, str, str],
+                                                  None]] = None):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout_s = float(reset_timeout_s)
         self.clock = clock
         self.name = name
+        # observer fired as (breaker_name, old_state, new_state) on EVERY
+        # state change, outside the breaker lock (a hook may read state)
+        self.on_transition = on_transition
         self._lock = threading.Lock()
         self._state = CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
         self._probe_inflight = False
+        self._pending_transitions: list = []
         self.trips = 0          # times the breaker went CLOSED/HALF_OPEN→OPEN
         self.rejected = 0       # calls refused while OPEN
+
+    def _set_state(self, new: str) -> None:
+        """Must hold self._lock; queues the transition for hooks."""
+        if new != self._state:
+            self._pending_transitions.append((self._state, new))
+        self._state = new
+
+    def _fire_transitions(self) -> None:
+        """Must NOT hold self._lock. Hook failures are logged, never
+        raised — telemetry must not take down the breaker's caller (the
+        serving batcher thread calls this from its failure path)."""
+        with self._lock:
+            pending, self._pending_transitions = (
+                self._pending_transitions, [])
+        hook = self.on_transition
+        for old, new in pending:
+            if hook is not None:
+                try:
+                    hook(self.name, old, new)
+                except Exception:
+                    logger.exception(
+                        "circuit %s on_transition hook failed (%s -> %s)",
+                        self.name, old, new)
 
     @property
     def state(self) -> str:
         with self._lock:
             self._maybe_half_open()
-            return self._state
+            out = self._state
+        self._fire_transitions()
+        return out
 
     def _maybe_half_open(self) -> None:
         if (self._state == OPEN
                 and self.clock.monotonic() - self._opened_at
                 >= self.reset_timeout_s):
-            self._state = HALF_OPEN
+            self._set_state(HALF_OPEN)
             self._probe_inflight = False
 
     def retry_after(self) -> float:
@@ -224,9 +275,12 @@ class CircuitBreaker:
         with self._lock:
             self._maybe_half_open()
             if self._state != OPEN:
-                return 0.0
-            return max(0.0, self._opened_at + self.reset_timeout_s
-                       - self.clock.monotonic())
+                out = 0.0
+            else:
+                out = max(0.0, self._opened_at + self.reset_timeout_s
+                          - self.clock.monotonic())
+        self._fire_transitions()
+        return out
 
     def allow(self) -> bool:
         """True if a call may proceed now (counts a rejection otherwise).
@@ -238,10 +292,13 @@ class CircuitBreaker:
             if self._state == OPEN or (self._state == HALF_OPEN
                                        and self._probe_inflight):
                 self.rejected += 1
-                return False
-            if self._state == HALF_OPEN:
-                self._probe_inflight = True
-            return True
+                out = False
+            else:
+                if self._state == HALF_OPEN:
+                    self._probe_inflight = True
+                out = True
+        self._fire_transitions()
+        return out
 
     def record_success(self) -> None:
         with self._lock:
@@ -250,7 +307,8 @@ class CircuitBreaker:
             if self._state != CLOSED:
                 logger.info("circuit %s closed after successful probe",
                             self.name)
-            self._state = CLOSED
+            self._set_state(CLOSED)
+        self._fire_transitions()
 
     def record_failure(self) -> None:
         with self._lock:
@@ -260,13 +318,14 @@ class CircuitBreaker:
             if self._state == HALF_OPEN or (
                     self._state == CLOSED
                     and self._consecutive_failures >= self.failure_threshold):
-                self._state = OPEN
+                self._set_state(OPEN)
                 self._opened_at = self.clock.monotonic()
                 self.trips += 1
                 logger.warning(
                     "circuit %s OPEN after %d consecutive failures "
                     "(cool-down %.1fs)", self.name,
                     self._consecutive_failures, self.reset_timeout_s)
+        self._fire_transitions()
 
     def call(self, fn: Callable, *args, **kwargs):
         """Run ``fn`` under the breaker: refused with
@@ -282,6 +341,25 @@ class CircuitBreaker:
             raise
         self.record_success()
         return out
+
+
+# numeric encoding for breaker-state gauges (Prometheus has no enums)
+STATE_VALUES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+def metrics_transition_hook(registry=None) -> Callable[[str, str, str], None]:
+    """An ``on_transition`` hook recording every breaker state change as
+    ``breaker_transitions_total{breaker,from_state,to_state}``."""
+    from . import metrics as _metrics
+    reg = registry if registry is not None else _metrics.REGISTRY
+    transitions = reg.counter(
+        "breaker_transitions_total", "Circuit breaker state transitions",
+        ("breaker", "from_state", "to_state"))
+
+    def hook(name: str, old: str, new: str) -> None:
+        transitions.inc(breaker=name, from_state=old, to_state=new)
+
+    return hook
 
 
 class NonFiniteGuard:

@@ -1,0 +1,193 @@
+"""Tracing substrate: spans with parent links, JSONL export, seam context.
+
+The companion of :mod:`deeplearning4j_tpu.util.metrics`: metrics say *how
+often* and *how long* in aggregate; a trace says what ONE request did —
+queue wait → batch assembly → model call as parented spans with wall +
+monotonic timestamps.
+
+Spans cross threads (an HTTP handler enqueues, the batcher answers), so
+parenting is explicit: ``tracer.start(name, parent=...)`` / ``span.end()``
+for cross-thread spans, and the ``tracer.span(...)`` context manager for
+same-thread nesting (the active span is tracked per-thread and becomes
+the default parent).
+
+Chaos-test integration: entering ``span()`` stamps the active span into
+the :mod:`deeplearning4j_tpu.util.faults` seam context, so a scripted
+fault records WHICH span it landed in (``FaultPlan.trigger_context``) —
+"the injected infer failure hit the model-call span of trace X" becomes
+an assertable fact instead of a guess.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+import weakref
+from typing import Any, Dict, List, Optional
+
+from . import faults as _faults
+
+
+class Span:
+    """One timed operation. ``start_unix`` is wall time (for humans and
+    cross-process alignment); durations come from the monotonic clock."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "attributes",
+                 "start_unix", "_start_mono", "duration_ms", "status",
+                 "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str],
+                 attributes: Optional[Dict[str, Any]] = None):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.attributes: Dict[str, Any] = dict(attributes or {})
+        self.start_unix = time.time()
+        self._start_mono = time.perf_counter()
+        self.duration_ms: Optional[float] = None
+        self.status = "ok"
+
+    def set_attribute(self, key: str, value: Any) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def end(self, status: Optional[str] = None) -> None:
+        """Close the span (idempotent) and hand it to the tracer."""
+        if self.duration_ms is not None:
+            return
+        self.duration_ms = (time.perf_counter() - self._start_mono) * 1000.0
+        if status is not None:
+            self.status = status
+        self._tracer._finish(self)
+
+    def context(self) -> Dict[str, str]:
+        """The identifying triple stamped into fault-seam payloads."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "name": self.name}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "start_unix": self.start_unix,
+                "duration_ms": self.duration_ms, "status": self.status,
+                "attributes": self.attributes}
+
+
+class _ActiveStack(threading.local):
+    def __init__(self):
+        self.stack: List[Span] = []
+
+
+class Tracer:
+    """Creates spans and collects the finished ones for export.
+
+    ``max_spans`` bounds memory: a long-lived server keeps the newest N
+    finished spans (the export is a flight recorder, not an archive).
+    """
+
+    def __init__(self, max_spans: int = 10000):
+        self.max_spans = int(max_spans)
+        self._lock = threading.Lock()
+        self._finished: List[Span] = []
+        self._active = _ActiveStack()
+        with _tracers_lock:
+            _live_tracers.add(self)
+
+    # -- creation ------------------------------------------------------
+
+    def start(self, name: str, parent: Optional[Span] = None,
+              attributes: Optional[Dict[str, Any]] = None) -> Span:
+        """Explicit-lifetime span (cross-thread safe): caller must call
+        ``span.end()``. Defaults the parent to this thread's active span."""
+        if parent is None:
+            parent = self.current()
+        trace_id = parent.trace_id if parent else uuid.uuid4().hex
+        return Span(self, name, trace_id,
+                    parent.span_id if parent else None, attributes)
+
+    def span(self, name: str, parent: Optional[Span] = None,
+             attributes: Optional[Dict[str, Any]] = None):
+        """Context manager: starts a span, makes it this thread's active
+        span (and the fault-seam context), ends it on exit — status
+        "error" if the block raised."""
+        tracer = self
+        s = self.start(name, parent, attributes)
+
+        class _Ctx:
+            def __enter__(self):
+                tracer._active.stack.append(s)
+                return s
+
+            def __exit__(self, exc_type, exc, tb):
+                stack = tracer._active.stack
+                if stack and stack[-1] is s:
+                    stack.pop()
+                s.end("error" if exc_type is not None else None)
+                return False
+
+        return _Ctx()
+
+    def current(self) -> Optional[Span]:
+        """This thread's innermost open ``span()`` block."""
+        stack = self._active.stack
+        return stack[-1] if stack else None
+
+    # -- collection / export -------------------------------------------
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            self._finished.append(span)
+            if len(self._finished) > self.max_spans:
+                del self._finished[:len(self._finished) - self.max_spans]
+
+    @property
+    def finished(self) -> List[Span]:
+        with self._lock:
+            return list(self._finished)
+
+    def find(self, name: str) -> List[Span]:
+        return [s for s in self.finished if s.name == name]
+
+    def to_jsonl(self) -> str:
+        return "".join(json.dumps(s.to_dict()) + "\n"
+                       for s in self.finished)
+
+    def export_jsonl(self, path: str) -> int:
+        """Write one JSON object per finished span; returns the count."""
+        spans = self.finished
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s.to_dict()) + "\n")
+        return len(spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+# ---------------------------------------------------------------------------
+# fault-seam context: faults.check() payloads carry the active span
+# ---------------------------------------------------------------------------
+
+_tracers_lock = threading.Lock()
+_live_tracers: "weakref.WeakSet[Tracer]" = weakref.WeakSet()
+
+
+def _seam_context() -> Dict[str, Any]:
+    """Called by faults.check(): the active span of ANY live tracer on
+    this thread (at most one — span() stacks are per-thread)."""
+    with _tracers_lock:
+        tracers = list(_live_tracers)
+    for t in tracers:
+        s = t.current()
+        if s is not None:
+            return {"span": s.context()}
+    return {}
+
+
+_faults.add_context_provider(_seam_context)
