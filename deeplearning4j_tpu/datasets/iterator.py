@@ -13,7 +13,6 @@ the role the reference's device-affinity prefetch played for GPUs.
 
 from __future__ import annotations
 
-import queue
 import threading
 from typing import Iterable, Iterator, List, Optional
 
@@ -212,9 +211,14 @@ class AsyncDataSetIterator(DataSetIterator):
     base iterator into a bounded queue while the training loop consumes.
     With ``device_put=True`` the producer also ships each batch to the
     device so the next step's HBM transfer overlaps the current step.
-    """
 
-    _SENTINEL = object()
+    The producer watches a stop flag between puts, so ``reset()`` is
+    O(queue_size): it poisons the running producer, discards the staged
+    queue, and restarts on a reset base — it does NOT drain the rest of
+    the epoch through the consumer. Producer errors are raised on the
+    consumer as soon as they are observed (fail fast), not deferred until
+    every already-staged batch has been drained.
+    """
 
     def __init__(self, base: DataSetIterator, queue_size: int = 2,
                  device_put: bool = False, device=None):
@@ -222,9 +226,6 @@ class AsyncDataSetIterator(DataSetIterator):
         self.queue_size = max(1, int(queue_size))
         self.device_put = device_put
         self.device = device
-        self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
-        self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
         self._peek = None
         self._start()
 
@@ -238,19 +239,25 @@ class AsyncDataSetIterator(DataSetIterator):
             None if ds.labels_mask is None
             else jax.device_put(ds.labels_mask, self.device))
 
-    def _producer(self) -> None:
+    def _producer(self, pq) -> None:
         try:
             for ds in self.base:
+                if pq.stop.is_set():
+                    return
                 if self.device_put:
                     ds = self._stage(ds)
-                self._queue.put(ds)
+                if not pq.put(ds):
+                    return
         except BaseException as e:  # surfaced on the consumer side
-            self._error = e
+            pq.fail(e)
         finally:
-            self._queue.put(self._SENTINEL)
+            pq.finish()
 
     def _start(self) -> None:
-        self._thread = threading.Thread(target=self._producer, daemon=True)
+        from ..util.ingest import ProducerQueue
+        self._pq = ProducerQueue(self.queue_size)
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._pq,), daemon=True)
         self._thread.start()
 
     @property
@@ -259,13 +266,14 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def has_next(self) -> bool:
         if self._peek is None:
-            self._peek = self._queue.get()
-        if self._peek is self._SENTINEL:
-            if self._error is not None:
-                err, self._error = self._error, None
-                raise err
-            return False
-        return True
+            try:
+                # fail fast: a producer error raises here as soon as it
+                # is observed, even with staged batches still queued
+                self._peek = self._pq.get()
+            except BaseException:
+                self._peek = self._pq.SENTINEL   # stream over after error
+                raise
+        return self._peek is not self._pq.SENTINEL
 
     def next(self) -> DataSet:
         if not self.has_next():
@@ -274,13 +282,22 @@ class AsyncDataSetIterator(DataSetIterator):
         return out
 
     def reset(self) -> None:
-        # drain the running producer fully, then restart on a reset base
-        while self.has_next():
-            self.next()
+        if not self._pq.drain_and_join(self._thread):
+            # restarting would race a second producer against the same
+            # base iterator — refuse instead of corrupting it
+            raise RuntimeError(
+                "async producer did not stop within 5s (base iterator "
+                "blocked in next()?) — cannot safely reset")
         self._peek = None
         self.base.reset()
-        self._queue = queue.Queue(maxsize=self.queue_size)
         self._start()
+
+    def close(self) -> None:
+        """Stop the producer without restarting (for abandoned epochs).
+        Best effort: nothing restarts over the base, so a stuck producer
+        is left to die with the process."""
+        self._pq.drain_and_join(self._thread)
+        self._peek = self._pq.SENTINEL
 
 
 class AsyncMultiDataSetIterator(AsyncDataSetIterator):

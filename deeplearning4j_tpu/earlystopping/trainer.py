@@ -34,8 +34,16 @@ class BaseEarlyStoppingTrainer:
         reason, details = "epoch_condition", ""
 
         while True:
+            # lazy epoch-start reset: the final epoch (or an
+            # iteration-condition stop) never restarts the producer just
+            # to discard the work; epoch 0 revives an iterator a previous
+            # fit() left exhausted (same contract as run_fit_loop)
+            if hasattr(self.train_data, "reset") and (
+                    epoch > 0 or (hasattr(self.train_data, "has_next")
+                                  and not self.train_data.has_next())):
+                self.train_data.reset()
             stop_iteration = None
-            for x, y, mask in self._batches():
+            for x, y, mask in self._staged_batches():
                 loss = float(self._fit_batch(x, y, mask))
                 for c in cfg.iteration_termination_conditions:
                     if c.terminate(loss):
@@ -43,8 +51,6 @@ class BaseEarlyStoppingTrainer:
                         break
                 if stop_iteration is not None:
                     break
-            if hasattr(self.train_data, "reset"):
-                self.train_data.reset()
 
             if stop_iteration is not None:
                 reason = "iteration_condition"
@@ -89,6 +95,23 @@ class BaseEarlyStoppingTrainer:
 
     def _fit_batch(self, x, y, mask):
         raise NotImplementedError
+
+    def _staged_batches(self):
+        """The ingest-staged view of ``_batches()``: background
+        ``jax.device_put`` double-buffering for iterator sources (same
+        stage ``fit()`` uses), plain pass-through for single DataSets or
+        already-device-staged async iterators."""
+        from ..util import ingest as _ingest
+        data = self.train_data
+        if (hasattr(data, "features") or not _ingest.staging_enabled()
+                or _ingest.already_staged(data)):
+            yield from self._batches()
+            return
+        staged = _ingest.stage(self._batches(), stage_name="earlystopping")
+        try:
+            yield from staged
+        finally:
+            staged.close()
 
     def _batches(self):
         """Yield (features, labels, mask) triples from train_data."""

@@ -536,7 +536,8 @@ class ComputationGraph:
     def _train_step(self):
         fn = self._jit_cache.get("train_step")
         if fn is None:
-            fn = self._make_train_step()
+            fn = _xla.retrace_guard(self._make_train_step(),
+                                    "ComputationGraph.train_step")
             self._jit_cache["train_step"] = fn
         return fn
 
@@ -552,10 +553,17 @@ class ComputationGraph:
 
     def _fire_iteration(self, batch_size, loss):
         self.iteration_count += 1
+        if not self.listeners:
+            return
+        # LazyScore delivery: the device loss syncs to host only when a
+        # listener actually reads it (host scalars from the fused-scan
+        # replay pass through)
+        from ..util.ingest import as_listener_score
+        score = as_listener_score(loss)
         for l in self.listeners:
             if hasattr(l, "record_batch"):
                 l.record_batch(batch_size)
-            l.iteration_done(self, self.iteration_count, loss)
+            l.iteration_done(self, self.iteration_count, score)
 
     def _make_train_scan(self):
         """K train steps fused into ONE lax.scan XLA program (same design as
@@ -605,7 +613,8 @@ class ComputationGraph:
                      for m in _as_list(masks)]
         fn = self._jit_cache.get("train_scan")
         if fn is None:
-            fn = self._make_train_scan()
+            fn = _xla.retrace_guard(self._make_train_scan(),
+                                    "ComputationGraph.train_scan")
             self._jit_cache["train_scan"] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, losses = fn(
@@ -678,7 +687,8 @@ class ComputationGraph:
                      for m in _as_list(masks)]
         fn = self._jit_cache.get("train_repeat")
         if fn is None:
-            fn = self._make_train_repeat()
+            fn = _xla.retrace_guard(self._make_train_repeat(),
+                                    "ComputationGraph.train_repeat")
             self._jit_cache["train_repeat"] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, losses = fn(
@@ -808,21 +818,22 @@ class ComputationGraph:
         self._persist_states(new_states)
         return loss
 
-    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            coalesce: Optional[int] = None) -> None:
         """Train from (inputs, labels), a DataSet/MultiDataSet, or an iterator
-        of either (parity: fit variants :614-760)."""
+        of either (parity: fit variants :614-760).
+
+        Same async-dispatch loop as ``MultiLayerNetwork.fit``: background
+        device staging for iterator sources, bounded in-flight window,
+        LazyScore listener delivery, lazy epoch-start resets (the final
+        epoch never restarts the producer), optional same-shape
+        coalescing via ``coalesce=K`` / ``DL4JTPU_COALESCE_K``.
+        """
+        from ..util.ingest import run_fit_loop
         if self.params is None:
             self.init()
-        for _ in range(epochs):
-            for l in self.listeners:
-                l.on_epoch_start(self, self.epoch_count)
-            for ins, outs, masks in self._as_batches(data, labels):
-                self.fit_batch(ins, outs, masks)
-            for l in self.listeners:
-                l.on_epoch_end(self, self.epoch_count)
-            self.epoch_count += 1
-            if hasattr(data, "reset"):
-                data.reset()
+        run_fit_loop(self, data, labels, None, epochs, coalesce,
+                     model_label="ComputationGraph")
 
     @staticmethod
     def _as_batches(data, labels=None, mask=None):
@@ -904,6 +915,11 @@ class ComputationGraph:
         from ..eval import Evaluation
         from ..util.batching import iter_batches
         ev = Evaluation()
+        # fit() no longer resets the source after its final epoch; revive
+        # an exhausted resettable iterator instead of evaluating nothing
+        if (hasattr(data, "has_next") and not data.has_next()
+                and hasattr(data, "reset")):
+            data.reset()
         for x, y, m, meta in iter_batches(data, labels, with_meta=True):
             out = self.output(jnp.asarray(np.asarray(x)))
             ev.eval(np.asarray(y), np.asarray(out),

@@ -411,7 +411,8 @@ class MultiLayerNetwork:
     def _train_step(self):
         fn = self._jit_cache.get("train_step")
         if fn is None:
-            fn = self._make_train_step()
+            fn = _xla.retrace_guard(self._make_train_step(),
+                                    "MultiLayerNetwork.train_step")
             self._jit_cache["train_step"] = fn
         return fn
 
@@ -466,7 +467,8 @@ class MultiLayerNetwork:
             masks = jnp.asarray(masks)
         fn = self._jit_cache.get("train_scan")
         if fn is None:
-            fn = self._make_train_scan()
+            fn = _xla.retrace_guard(self._make_train_scan(),
+                                    "MultiLayerNetwork.train_scan")
             self._jit_cache["train_scan"] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         states = self._states_list()
@@ -535,7 +537,8 @@ class MultiLayerNetwork:
             mask = jnp.asarray(mask)
         fn = self._jit_cache.get("train_repeat")
         if fn is None:
-            fn = self._make_train_repeat()
+            fn = _xla.retrace_guard(self._make_train_repeat(),
+                                    "MultiLayerNetwork.train_repeat")
             self._jit_cache["train_repeat"] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, losses = fn(
@@ -569,31 +572,29 @@ class MultiLayerNetwork:
     def add_listener(self, listener) -> None:
         self.listeners.append(listener)
 
-    def fit(self, data, labels=None, *, epochs: int = 1, mask=None) -> None:
+    def fit(self, data, labels=None, *, epochs: int = 1, mask=None,
+            coalesce: Optional[int] = None) -> None:
         """Train. `data` may be:
           - (features, labels) arrays (`labels=None` form passes labels here),
           - a DataSet (has .features/.labels),
           - an iterator yielding DataSets or (features, labels) tuples.
+
+        The loop is dispatch-asynchronous: host batches are device-staged
+        by a background thread (``util.ingest.stage``; ``DL4JTPU_INGEST=0``
+        disables), losses stay on device behind a bounded in-flight window
+        (``DL4JTPU_MAX_INFLIGHT``), and listeners receive a ``LazyScore``
+        that syncs only when read. ``coalesce=K`` (or ``DL4JTPU_COALESCE_K``)
+        additionally fuses runs of K same-shape batches into one fit_scan
+        dispatch — opt-in, because the fused path derives per-step rng
+        differently. Epoch resets happen lazily at the START of each
+        subsequent epoch, so the final epoch never restarts the producer
+        just to throw the work away.
         """
+        from ..util.ingest import run_fit_loop
         if self.params is None:
             self.init()
-        for epoch in range(epochs):
-            for l in self.listeners:
-                l.on_epoch_start(self, self.epoch_count)
-            n_batches = 0
-            for batch in self._as_batches(data, labels, mask):
-                self.fit_batch(*batch)
-                n_batches += 1
-            if n_batches == 0 and epoch > 0:
-                raise ValueError(
-                    f"epoch {epoch} yielded no batches — the data iterator is "
-                    "exhausted and has no reset(); pass a resettable iterator "
-                    "(e.g. datasets.ListDataSetIterator) when epochs > 1")
-            for l in self.listeners:
-                l.on_epoch_end(self, self.epoch_count)
-            self.epoch_count += 1
-            if hasattr(data, "reset"):
-                data.reset()
+        run_fit_loop(self, data, labels, mask, epochs, coalesce,
+                     model_label="MultiLayerNetwork")
 
     @staticmethod
     def _as_batches(data, labels=None, mask=None):
@@ -679,10 +680,17 @@ class MultiLayerNetwork:
 
     def _fire_iteration(self, batch_size, loss):
         self.iteration_count += 1
+        if not self.listeners:
+            return
+        # listeners get a LazyScore: the device loss syncs to host only
+        # when (and if) a listener actually reads it — frequency-gated
+        # listeners pay one sync per window, silent ones pay zero
+        from ..util.ingest import as_listener_score
+        score = as_listener_score(loss)
         for l in self.listeners:
             if hasattr(l, "record_batch"):
                 l.record_batch(batch_size)
-            l.iteration_done(self, self.iteration_count, loss)
+            l.iteration_done(self, self.iteration_count, score)
 
     # ------------------------------------------------------------------
     # layerwise pretraining (parity: MultiLayerNetwork.pretrain :1052 —
@@ -763,6 +771,12 @@ class MultiLayerNetwork:
         from ..eval import Evaluation
         from ..util.batching import iter_batches
         ev = Evaluation()
+        # fit() no longer resets the source after its final epoch; revive
+        # an exhausted resettable iterator here instead of silently
+        # evaluating zero batches
+        if (hasattr(data, "has_next") and not data.has_next()
+                and hasattr(data, "reset")):
+            data.reset()
         for x, y, m, meta in iter_batches(data, labels, with_meta=True):
             out = self.output(jnp.asarray(x))
             ev.eval(np.asarray(y), np.asarray(out),

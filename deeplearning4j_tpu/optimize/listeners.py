@@ -9,9 +9,12 @@ onGradientCalculation, onBackwardPass — fired at
 ``CollectScoresIterationListener.java``, ``ComposableIterationListener.java``.
 
 TPU-native note: the jitted train step runs async on device; listeners fire on
-the host *after* the step is dispatched. Reading `score` forces a device sync,
-so `PerformanceListener` reports true end-to-end throughput (device compute +
-host overhead), and listeners that don't need the score avoid blocking.
+the host *after* the step is dispatched. ``score`` arrives as a
+:class:`~deeplearning4j_tpu.util.ingest.LazyScore`: calling ``float(score)``
+performs the device→host sync (counted in ``training_host_syncs_total``), so
+a listener gating on ``iteration % frequency`` costs one sync per window and
+a listener that never reads the score costs none. Don't read the score
+outside your frequency window — that re-serializes the async dispatch loop.
 """
 
 from __future__ import annotations
@@ -28,6 +31,9 @@ class TrainingListener:
     iteration counter (minibatches seen)."""
 
     def iteration_done(self, model, iteration: int, score) -> None:
+        """``score`` is host-lazy (``LazyScore``): ``float(score)`` blocks
+        on the device and transfers — do it at most once per frequency
+        window."""
         pass
 
     def on_epoch_start(self, model, epoch: int) -> None:
@@ -171,20 +177,23 @@ class MetricsListener(TrainingListener):
     trainers — the scrapeable twin of StatsListener (which feeds the UI).
 
     Reading ``score`` forces a device sync (same caveat as
-    ScoreIterationListener); pass ``record_score=False`` to keep the
-    listener off the async dispatch path.
+    ScoreIterationListener); ``frequency=N`` reads it only every Nth
+    iteration (the counters and wall-time histogram stay per-step — they
+    never touch the device), and ``record_score=False`` keeps the
+    listener entirely off the async dispatch path.
     """
 
     _ITER_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5, 5.0, 10.0)
 
     def __init__(self, registry=None, name: str = "net",
-                 record_score: bool = True):
+                 record_score: bool = True, frequency: int = 1):
         from ..util import metrics as _metrics
         reg = registry if registry is not None else _metrics.REGISTRY
         self.registry = reg
         self.name = name
         self.record_score = record_score
+        self.frequency = max(1, int(frequency))
         self._iterations = reg.counter(
             "training_iterations_total", "Training iterations completed",
             ("model",))
@@ -207,7 +216,7 @@ class MetricsListener(TrainingListener):
         if self._last_time is not None:
             self._iter_time.observe(now - self._last_time, model=self.name)
         self._last_time = now
-        if self.record_score:
+        if self.record_score and iteration % self.frequency == 0:
             self._score.set(float(score), model=self.name)
 
     def on_epoch_end(self, model, epoch):

@@ -210,22 +210,45 @@ class ParallelWrapper:
         local = (self._ensure_local()
                  if self.averaging_frequency > 1 else None)
         net = self.net
+        from ..util import ingest as _ingest
+        single = (labels is not None or hasattr(data, "shape")
+                  or hasattr(data, "features"))
         for epoch in range(epochs):
+            # lazy epoch-start reset (final epoch never restarts the
+            # producer); revive an iterator a previous fit() exhausted
+            if hasattr(data, "reset") and (
+                    epoch > 0 or (hasattr(data, "has_next")
+                                  and not data.has_next())):
+                data.reset()
             for l in net.listeners:
                 l.on_epoch_start(net, net.epoch_count)
-            batch_iter = iter(net._as_batches(data, labels, mask))
+            source = net._as_batches(data, labels, mask)
+            staged = None
+            if (not single and _ingest.staging_enabled()
+                    and not _ingest.already_staged(data)):
+                # prefetch-only staging (device_put=False): the sharded
+                # replica step places batches with its own shardings, so
+                # ingest here overlaps host batch PREP, not placement
+                staged = _ingest.stage(source, stage_name="parallel",
+                                       device_put=False)
+                source = staged
+            batch_iter = iter(source)
             n_batches = 0
-            while True:
-                with maybe_time_phase(self.stats, "batch_prep"):
-                    batch = next(batch_iter, None)
-                if batch is None:
-                    break
-                n_batches += 1
-                x, y, m = batch
-                if local is not None:
-                    self._timed_local_step(local, x, y, m)
-                else:
-                    self._timed_sync_step(x, y, m)
+            try:
+                while True:
+                    with maybe_time_phase(self.stats, "batch_prep"):
+                        batch = next(batch_iter, None)
+                    if batch is None:
+                        break
+                    n_batches += 1
+                    x, y, m = batch
+                    if local is not None:
+                        self._timed_local_step(local, x, y, m)
+                    else:
+                        self._timed_sync_step(x, y, m)
+            finally:
+                if staged is not None:
+                    staged.close()
             if n_batches == 0 and epoch > 0:
                 raise ValueError(
                     f"epoch {epoch} yielded no batches — the data iterator is "
@@ -234,8 +257,6 @@ class ParallelWrapper:
             for l in net.listeners:
                 l.on_epoch_end(net, net.epoch_count)
             net.epoch_count += 1
-            if hasattr(data, "reset"):
-                data.reset()
         if local is not None:
             self._timed_sync_to_net(local)
 

@@ -52,7 +52,13 @@ def _histogram(arr: np.ndarray, bins: int = 20) -> Dict[str, Any]:
 class StatsListener(TrainingListener):
     """Collects stats every ``frequency`` iterations and routes them to
     storage. ``collect_histograms`` adds per-param histograms + norms
-    (off by default: it syncs params to host)."""
+    (off by default: it syncs params to host).
+
+    Async-dispatch contract: ``score`` arrives as a lazy on-device value
+    (``util.ingest.LazyScore``); this listener reads it only on collected
+    iterations, so at ``frequency=N`` the fit loop pays exactly one
+    device→host sync per N steps — off-frequency iterations return
+    before ``float(score)`` and never block the dispatch pipeline."""
 
     def __init__(self, router: StatsStorageRouter, frequency: int = 1,
                  session_id: Optional[str] = None, worker_id: str = "worker_0",

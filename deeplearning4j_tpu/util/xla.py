@@ -16,8 +16,12 @@ literal ``off`` to disable all options (including any future defaults).
 
 from __future__ import annotations
 
+import functools
+import logging
 import os
-from typing import Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 _TRAIN_DEFAULTS: Dict[str, str] = {}
 
@@ -56,3 +60,61 @@ def train_step_options() -> Optional[Dict[str, str]]:
         k, v = pair.split("=", 1)
         opts[k.strip()] = v.strip()
     return opts or None
+
+
+# ----------------------------------------------------------------------
+# retrace guard
+# ----------------------------------------------------------------------
+
+def _abstract_signature(args: tuple, kwargs: dict) -> Tuple:
+    """The (shape, dtype) skeleton jit keys its compilation cache on —
+    arrays by shape+dtype, python scalars/static args by value, anything
+    else by type."""
+    import jax
+
+    def leaf_sig(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return ("a", tuple(leaf.shape), str(leaf.dtype))
+        if leaf is None or isinstance(leaf, (bool, int, float, str)):
+            return ("v", leaf)
+        return ("t", type(leaf).__name__)
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (str(treedef), tuple(leaf_sig(l) for l in leaves))
+
+
+def retrace_guard(fn: Callable, name: str, registry=None) -> Callable:
+    """Wrap a jitted callable to count compilations into
+    ``jit_retraces_total{fn=name}``.
+
+    Each call computes the abstract signature of its arguments (shape +
+    dtype skeleton — the same thing jit keys its cache on); a signature
+    never seen by THIS wrapper increments the counter. Steady-state
+    training therefore pins the counter at exactly 1 per guarded step
+    function, and the no-retrace regression test enforces it on CPU.
+
+    ``DL4JTPU_RETRACE_WARN=1`` additionally logs every retrace after the
+    first with the differing abstract signature — the fastest way to find
+    which input's shape/dtype is churning the compile cache.
+    """
+    from . import ingest as _ingest
+    counter = _ingest.retrace_counter(registry)
+    seen: Dict[Tuple, int] = {}
+    last: list = []
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        key = _abstract_signature(args, kwargs)
+        if key not in seen:
+            seen[key] = len(seen)
+            counter.inc(fn=name)
+            if seen[key] > 0 and os.environ.get("DL4JTPU_RETRACE_WARN") == "1":
+                logger.warning(
+                    "retrace #%d of %s — new abstract signature:\n  now:  "
+                    "%s\n  prev: %s", len(seen) - 1, name, key[1],
+                    last[0][1] if last else "?")
+            last[:] = [key]
+        return fn(*args, **kwargs)
+
+    wrapped.signatures_seen = seen
+    return wrapped

@@ -1,6 +1,8 @@
 """Data pipeline tests (parity model: reference datasets iterator tests —
 DataSetIteratorTest.java, AsyncDataSetIteratorTest / MultipleEpochsIteratorTest)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -110,6 +112,77 @@ class TestAsyncIterator:
         import jax
         b = it.next()
         assert isinstance(b.features, jax.Array)
+
+    def test_reset_under_load_is_o_queue(self):
+        """reset() poisons the producer instead of draining the remaining
+        epoch: with 10k batches pending, only O(queue_size) of them are
+        ever pulled from the base before the restart."""
+        class Counting(ArrayDataSetIterator):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.consumed = 0
+            def next(self):
+                self.consumed += 1
+                return super().next()
+        base = Counting(np.zeros((10_000, 1)), np.zeros((10_000, 1)), 1)
+        it = AsyncDataSetIterator(base, queue_size=2)
+        for _ in range(3):
+            it.next()
+        it.reset()
+        # the restarted producer may already be pulling again, but the
+        # pre-reset epoch was abandoned after O(queue_size) pulls
+        assert base.consumed < 100
+        assert sum(1 for _ in it) == 10_000     # full epoch after reset
+        assert base.consumed < 10_100           # epoch NOT consumed twice
+
+    def test_error_surfaces_before_queue_drains(self):
+        """Producer errors fail fast: the consumer sees the error as soon
+        as it is observed, not after every already-staged batch."""
+        class BoomAfter(ArrayDataSetIterator):
+            def next(self):
+                if self._cursor >= 2:
+                    raise RuntimeError("late boom")
+                return super().next()
+        it = AsyncDataSetIterator(
+            BoomAfter(np.zeros((50, 1)), np.zeros((50, 1)), 1),
+            queue_size=2)
+        # wait until the producer observed the error (2 staged batches
+        # may still sit in the queue)
+        for _ in range(200):
+            if it._pq.error is not None:
+                break
+            time.sleep(0.01)
+        with pytest.raises(RuntimeError, match="late boom"):
+            while it.has_next():
+                it.next()
+        assert not it.has_next()    # stream is over after the error
+
+    def test_multiple_epochs_under_async_streams_continuously(self):
+        """MultipleEpochsIterator under Async: one producer streams all
+        epochs — epoch transitions cost one base.reset(), never a queue
+        drain or thread restart."""
+        class Counting(ArrayDataSetIterator):
+            resets = 0
+            def reset(self):
+                type(self).resets += 1
+                super().reset()
+        Counting.resets = 0
+        base = Counting(np.zeros((20, 1)), np.zeros((20, 1)), 4)
+        it = AsyncDataSetIterator(MultipleEpochsIterator(3, base),
+                                  queue_size=2)
+        thread_at_start = it._thread
+        assert sum(1 for _ in it) == 15     # 3 epochs x 5 batches
+        assert Counting.resets == 2         # epoch transitions only
+        assert it._thread is thread_at_start    # no producer restart
+
+    def test_close_stops_producer(self):
+        base = ArrayDataSetIterator(np.zeros((1000, 1)), np.zeros((1000, 1)),
+                                    1)
+        it = AsyncDataSetIterator(base, queue_size=2)
+        it.next()
+        it.close()
+        assert not it._thread.is_alive()
+        assert not it.has_next()
 
 
 class TestFetchers:
@@ -321,3 +394,49 @@ class TestAsyncMultiDataSetIterator:
         for m in it:
             loss = net.fit_batch(m.features, m.labels)
         assert np.isfinite(float(loss))
+
+    def test_producer_error_propagates(self):
+        from deeplearning4j_tpu.datasets import AsyncMultiDataSetIterator
+
+        class BoomIter:
+            batch_size = 2
+            def __iter__(self):
+                raise RuntimeError("multi boom")
+            def has_next(self):
+                return True
+            def reset(self):
+                pass
+
+        it = AsyncMultiDataSetIterator(BoomIter(), queue_size=2)
+        with pytest.raises(RuntimeError, match="multi boom"):
+            it.next()
+        assert not it.has_next()
+
+    def test_reset_under_load(self, rng):
+        from deeplearning4j_tpu.datasets import AsyncMultiDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        class Source:
+            batch_size = 2
+            def __init__(self):
+                self.consumed = 0
+                self._i = 0
+            def has_next(self):
+                return self._i < 5000
+            def next(self):
+                self.consumed += 1
+                self._i += 1
+                return MultiDataSet([np.zeros((2, 2), np.float32)],
+                                    [np.zeros((2, 1), np.float32)])
+            def reset(self):
+                self._i = 0
+            def __iter__(self):
+                while self.has_next():
+                    yield self.next()
+
+        src = Source()
+        it = AsyncMultiDataSetIterator(src, queue_size=2)
+        it.next()
+        it.reset()
+        assert src.consumed < 100   # poisoned, not drained
+        assert sum(1 for _ in it) == 5000

@@ -1,0 +1,366 @@
+"""Host ingest & async dispatch tests (the ISSUE 4 plane).
+
+Covers: LazyScore sync accounting (a listener that never reads the score
+forces ZERO host syncs; a frequency-N listener forces one per window),
+the bounded in-flight window, background device staging (ordering, error
+propagation, close, metrics, spans), same-shape coalescing, the retrace
+guard, and the acceptance-criteria regression: steady-state fit() over
+same-shape batches compiles the step function exactly once — enforced on
+the CPU backend so CI holds the line.
+"""
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.util import ingest
+from deeplearning4j_tpu.util import metrics as _metrics
+from deeplearning4j_tpu.util.xla import retrace_guard
+
+
+def _mln(seed=1, n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, n_in=4, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return x, y
+
+
+def _retraces(fn_name):
+    c = _metrics.REGISTRY.get("jit_retraces_total")
+    return 0.0 if c is None else c.value(fn=fn_name)
+
+
+def _syncs():
+    return ingest.sync_counter().value()
+
+
+class CountingSyncListener(TrainingListener):
+    """The counting-sync test double: reads the score every ``read_every``
+    iterations (0 = never) and records what it saw."""
+
+    def __init__(self, read_every: int = 0):
+        self.read_every = read_every
+        self.seen = []
+        self.iterations = 0
+
+    def iteration_done(self, model, iteration, score):
+        self.iterations += 1
+        if self.read_every and iteration % self.read_every == 0:
+            self.seen.append(float(score))
+
+
+class TestLazyScore:
+    def test_sync_only_on_read(self):
+        import jax.numpy as jnp
+        before = _syncs()
+        s = ingest.LazyScore(jnp.float32(2.5))
+        assert not s.resolved
+        assert _syncs() == before           # wrapping costs nothing
+        assert float(s) == 2.5
+        assert s.resolved
+        assert _syncs() == before + 1
+        assert float(s) == 2.5              # cached: still one sync
+        assert _syncs() == before + 1
+        assert "2.5" in repr(s)
+
+    def test_host_scalars_pass_through(self):
+        assert ingest.as_listener_score(1.25) == 1.25
+        assert ingest.as_listener_score(np.float32(1.5)) == 1.5
+        import jax.numpy as jnp
+        assert isinstance(ingest.as_listener_score(jnp.float32(1.0)),
+                          ingest.LazyScore)
+
+
+class TestInflightWindow:
+    def test_bounds_pending(self):
+        import jax.numpy as jnp
+        w = ingest.InflightWindow(max_inflight=2)
+        for i in range(10):
+            w.push(jnp.float32(i) * 2)
+            assert len(w._pending) <= 2
+        w.drain()
+        assert not w._pending
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_MAX_INFLIGHT", "5")
+        assert ingest.InflightWindow().max_inflight == 5
+        monkeypatch.setenv("DL4JTPU_MAX_INFLIGHT", "0")
+        with pytest.raises(ValueError):
+            ingest.max_inflight_default()
+
+
+class TestStage:
+    def test_batches_staged_in_order_on_device(self):
+        import jax
+        batches = [(np.full((2, 3), i, np.float32),
+                    np.full((2, 1), i, np.float32), None) for i in range(7)]
+        staged = ingest.stage(iter(batches), stage_name="t_order")
+        got = list(staged)
+        assert len(got) == 7
+        for i, (x, y, m) in enumerate(got):
+            assert isinstance(x, jax.Array) and isinstance(y, jax.Array)
+            assert m is None
+            assert float(x[0, 0]) == i
+        bytes_c = _metrics.REGISTRY.get("ingest_h2d_bytes_total")
+        assert bytes_c.value(stage="t_order") == sum(
+            b[0].nbytes + b[1].nbytes for b in batches)
+        assert _metrics.REGISTRY.get("ingest_batches_staged_total").value(
+            stage="t_order") == 7
+
+    def test_source_error_propagates(self):
+        def boom():
+            yield (np.zeros((2, 2), np.float32), np.zeros((2, 1), np.float32),
+                   None)
+            raise RuntimeError("producer exploded")
+        staged = ingest.stage(boom(), stage_name="t_err")
+        it = iter(staged)
+        # fail fast: the error surfaces as soon as it is observed — maybe
+        # even before the already-staged batch is consumed
+        with pytest.raises(RuntimeError, match="producer exploded"):
+            for _ in range(5):
+                next(it)
+        # the stream is over after the error
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_close_stops_producer(self):
+        pulled = []
+
+        def source():
+            for i in range(10_000):
+                pulled.append(i)
+                yield (np.zeros((2, 2), np.float32),
+                       np.zeros((2, 1), np.float32), None)
+        staged = ingest.stage(source(), stage_name="t_close", queue_size=2)
+        next(iter(staged))
+        staged.close()
+        n = len(pulled)
+        assert n < 100          # O(queue), not O(source)
+        time.sleep(0.1)
+        assert len(pulled) == n     # producer really stopped
+
+    def test_device_put_false_keeps_host(self):
+        batches = [(np.zeros((2, 2), np.float32), None, None)]
+        got = list(ingest.stage(iter(batches), stage_name="t_host",
+                                device_put=False))
+        assert isinstance(got[0][0], np.ndarray)
+
+    def test_spans_when_traced(self):
+        from deeplearning4j_tpu.util.tracing import Tracer
+        tracer = Tracer()
+        batches = [(np.zeros((4, 2), np.float32), None, None)] * 3
+        list(ingest.stage(iter(batches), stage_name="t_span", tracer=tracer))
+        spans = tracer.find("ingest.stage")
+        assert len(spans) == 3
+        assert spans[0].attributes["bytes"] == 4 * 2 * 4
+
+
+class TestCoalesced:
+    def _b(self, shape=(4, 2)):
+        return (np.zeros(shape, np.float32), np.zeros((shape[0], 1),
+                                                      np.float32), None)
+
+    def test_exact_runs_become_scans(self):
+        out = list(ingest.coalesced([self._b() for _ in range(5)], 2))
+        kinds = [k for k, _ in out]
+        assert kinds == ["scan", "scan", "step"]   # 2+2 fused, tail single
+        xs, ys = out[0][1]
+        assert xs.shape == (2, 4, 2) and ys.shape == (2, 4, 1)
+
+    def test_shape_change_flushes(self):
+        batches = [self._b((4, 2)), self._b((4, 2)), self._b((3, 2)),
+                   self._b((3, 2))]
+        kinds = [k for k, _ in ingest.coalesced(batches, 2)]
+        assert kinds == ["scan", "scan"]
+        batches = [self._b((4, 2)), self._b((3, 2))]
+        kinds = [k for k, _ in ingest.coalesced(batches, 2)]
+        assert kinds == ["step", "step"]
+
+    def test_masked_batches_never_coalesce(self):
+        m = np.ones((4,), np.float32)
+        batches = [(np.zeros((4, 2), np.float32),
+                    np.zeros((4, 1), np.float32), m)] * 3
+        kinds = [k for k, _ in ingest.coalesced(batches, 2)]
+        assert kinds == ["step"] * 3
+
+    def test_k_below_two_is_identity(self):
+        batches = [self._b(), self._b()]
+        out = list(ingest.coalesced(iter(batches), 0))
+        assert [k for k, _ in out] == ["step", "step"]
+        assert out[0][1] is batches[0]
+
+
+class TestRetraceGuard:
+    def test_counts_distinct_signatures(self):
+        import jax
+        guarded = retrace_guard(jax.jit(lambda x: x * 2), "t_guard.f")
+        before = _retraces("t_guard.f")
+        a = np.zeros((3, 2), np.float32)
+        guarded(a)
+        guarded(a + 1)
+        guarded(np.zeros((3, 2), np.float32))
+        assert _retraces("t_guard.f") == before + 1    # same shape/dtype
+        guarded(np.zeros((4, 2), np.float32))          # new shape
+        assert _retraces("t_guard.f") == before + 2
+        guarded(np.zeros((3, 2), np.float64))          # new dtype
+        assert _retraces("t_guard.f") == before + 3
+        assert len(guarded.signatures_seen) == 3
+
+    def test_warn_logs_differing_signature(self, monkeypatch, caplog):
+        import jax
+        monkeypatch.setenv("DL4JTPU_RETRACE_WARN", "1")
+        guarded = retrace_guard(jax.jit(lambda x: x + 1), "t_guard.warn")
+        with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+            guarded(np.zeros((2, 2), np.float32))
+            assert not caplog.records            # first compile: no warning
+            guarded(np.zeros((5, 2), np.float32))
+        assert any("retrace #1 of t_guard.warn" in r.getMessage()
+                   for r in caplog.records)
+        msg = next(r.getMessage() for r in caplog.records
+                   if "t_guard.warn" in r.getMessage())
+        assert "(5, 2)" in msg and "(2, 2)" in msg
+
+
+class TestAsyncFitLoop:
+    def test_steady_state_fit_compiles_exactly_once(self):
+        """ISSUE 4 acceptance: a multi-epoch same-shape fit() performs
+        exactly ONE compilation of the train step (via jit_retraces_total,
+        CPU backend)."""
+        net = _mln()
+        x, y = _data(64)
+        before = _retraces("MultiLayerNetwork.train_step")
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=3)
+        assert net.iteration_count == 12
+        assert _retraces("MultiLayerNetwork.train_step") == before + 1
+
+    def test_graph_steady_state_single_compile(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+                .graph_builder().add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4)).build())
+        net = ComputationGraph(conf).init()
+        x, y = _data(48)
+        before = _retraces("ComputationGraph.train_step")
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=2)
+        assert _retraces("ComputationGraph.train_step") == before + 1
+
+    def test_silent_listener_forces_zero_host_syncs(self):
+        """ISSUE 4 acceptance: a listener that never reads the score
+        forces ZERO device→host loss transfers across the whole fit."""
+        net = _mln()
+        silent = CountingSyncListener(read_every=0)
+        net.set_listeners(silent)
+        x, y = _data(64)
+        before = _syncs()
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=2)
+        assert silent.iterations == 8
+        assert _syncs() == before
+
+    def test_frequency_listener_syncs_once_per_window(self):
+        """ISSUE 4 acceptance: ≤1 host sync per listener-frequency
+        window — 12 iterations at frequency 4 = exactly 3 syncs."""
+        net = _mln()
+        reader = CountingSyncListener(read_every=4)
+        net.set_listeners(reader)
+        x, y = _data(64)
+        before = _syncs()
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=3)
+        assert reader.iterations == 12
+        assert len(reader.seen) == 3
+        assert _syncs() == before + 3
+        assert all(np.isfinite(v) for v in reader.seen)
+
+    def test_final_epoch_skips_reset(self):
+        class CountingIter(ArrayDataSetIterator):
+            resets = 0
+            def reset(self):
+                type(self).resets += 1
+                super().reset()
+        x, y = _data(32)
+        it = CountingIter(x, y, 16)
+        net = _mln()
+        net.fit(it, epochs=3)
+        # resets happen lazily at epoch START: 2 for epochs 1 and 2,
+        # none after the final epoch
+        assert CountingIter.resets == 2
+        # a second fit() revives the exhausted iterator instead of
+        # silently training on zero batches
+        net.fit(it, epochs=1)
+        assert net.iteration_count == 8
+
+    def test_coalesced_fit_matches_update_count(self):
+        net = _mln()
+        x, y = _data(64)
+        before = _retraces("MultiLayerNetwork.train_scan")
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=2, coalesce=4)
+        assert net._update_count == 8
+        assert net.iteration_count == 8
+        assert _retraces("MultiLayerNetwork.train_scan") == before + 1
+
+    def test_fit_learns_with_staging(self):
+        net = _mln()
+        x, y = _data(96)
+        net.fit(ArrayDataSetIterator(x, y, 32), epochs=1)
+        first = net.score()
+        net.fit(ArrayDataSetIterator(x, y, 32), epochs=20)
+        assert net.score() < first
+
+    def test_staging_disabled_still_trains(self, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_INGEST", "0")
+        net = _mln()
+        x, y = _data(32)
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=2)
+        assert net.iteration_count == 4
+
+    def test_host_gap_histogram_recorded(self):
+        h = ingest.host_gap_histogram()
+        before = h.count(model="MultiLayerNetwork")
+        net = _mln()
+        x, y = _data(64)
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=1)
+        # 4 dispatches → 3 inter-dispatch gaps
+        assert h.count(model="MultiLayerNetwork") == before + 3
+
+
+class TestEarlyStoppingIngest:
+    def test_trainer_runs_through_staged_batches(self):
+        from deeplearning4j_tpu.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingTrainer, MaxEpochsTerminationCondition)
+        x, y = _data(64)
+        cfg = (EarlyStoppingConfiguration.builder()
+               .score_calculator(
+                   DataSetLossCalculator(ArrayDataSetIterator(x, y, 32)))
+               .epoch_termination_conditions(
+                   MaxEpochsTerminationCondition(2))
+               .build())
+        before = _metrics.REGISTRY.counter(
+            "ingest_batches_staged_total", "", ("stage",)).value(
+                stage="earlystopping")
+        result = EarlyStoppingTrainer(
+            cfg, _mln(), ArrayDataSetIterator(x, y, 16)).fit()
+        assert result.total_epochs >= 1
+        assert _metrics.REGISTRY.get("ingest_batches_staged_total").value(
+            stage="earlystopping") > before
