@@ -339,6 +339,75 @@ def bench_ingest() -> dict:
     return out
 
 
+def bench_checkpoint() -> dict:
+    """Async-checkpoint overhead (ISSUE 5 acceptance): steady-state
+    ``fit(iterator)`` step time with durable checkpointing OFF vs ON
+    (single-outstanding background writer, every ``frequency`` steps).
+    The commit must never block a step for a full write — the measured
+    delta plus the registry's ``checkpoint_write_seconds`` mean proves
+    the write cost stayed off the critical path."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.util import metrics as _metrics
+    from deeplearning4j_tpu.util.durable import (AsyncCheckpointWriter,
+                                                 CheckpointStore,
+                                                 DurableSession)
+
+    batch = int(os.environ.get("BENCH_CKPT_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_CKPT_STEPS", "64"))
+    frequency = int(os.environ.get("BENCH_CKPT_FREQ", "8"))
+    xs, ys = _stage_batches(1, batch, (784,), 10, seed=31)
+    hx, hy = np.asarray(xs[0]), np.asarray(ys[0])
+
+    def iterator():
+        return ListDataSetIterator([DataSet(hx, hy)] * steps,
+                                   batch_size=batch)
+
+    def timed_fit(writer=None):
+        net = MultiLayerNetwork(lenet()).init()
+        net.fit(iterator())                  # warmup/compile
+        np.asarray(net._score)
+        session = None
+        if writer is not None:
+            session = DurableSession(net, writer.store,
+                                     frequency=frequency, writer=writer)
+        t0 = time.perf_counter()
+        net.fit(iterator(), session=session)
+        np.asarray(net._score)
+        return 1000 * (time.perf_counter() - t0) / steps
+
+    off_ms = timed_fit()
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        writer = AsyncCheckpointWriter(CheckpointStore(ckpt_dir, keep=2))
+        on_ms = timed_fit(writer)
+        writer.drain()
+        writer.close()
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    out = {"step_ms_off": round(off_ms, 3), "step_ms_on": round(on_ms, 3),
+           "overhead_pct": round(100 * (on_ms - off_ms) / off_ms, 2),
+           "frequency": frequency, "steps": steps, "batch": batch}
+    hist = _metrics.REGISTRY.get("checkpoint_write_seconds")
+    if hist is not None:
+        snap = hist.snapshot()["series"]
+        if snap and snap[0]["count"]:
+            out["write_ms_mean"] = round(
+                1000 * snap[0]["sum"] / snap[0]["count"], 2)
+    commits = _metrics.REGISTRY.get("checkpoint_commits_total")
+    if commits is not None:
+        out["commits"] = sum(s["value"] for s in
+                             commits.snapshot()["series"])
+    return out
+
+
 def bench_lstm() -> dict:
     """Char-RNN GravesLSTM (BASELINE config #3): tokens/s through
     MultiLayerNetwork.fit_repeated on one-hot char sequences."""
@@ -523,6 +592,7 @@ def main() -> None:
         if resnet_res is not None:
             _run_config(out, "resnet50_pipeline", bench_resnet50_pipeline)
     _run_config(out, "ingest", bench_ingest)
+    _run_config(out, "checkpoint", bench_checkpoint)
     _run_config(out, "lstm", bench_lstm)
     _run_config(out, "word2vec", bench_word2vec)
     _run_config(out, "flash_attention", bench_flash_attention)

@@ -9,6 +9,14 @@ Parity: reference ``deeplearning4j-nn/.../datasets/iterator/`` —
 TPU-native: ``AsyncDataSetIterator`` additionally issues ``jax.device_put`` on
 the background thread so host→HBM DMA overlaps the previous step's compute —
 the role the reference's device-affinity prefetch played for GPUs.
+
+Seekable cursor protocol (``util.durable``): every in-tree iterator also
+implements ``state() -> dict`` / ``restore(state)`` — a JSON-serializable
+cursor such that restoring it on a freshly built pipeline reproduces the
+remaining batch stream exactly (replays zero batches, skips none). The
+async wrapper tags each prefetched batch with the base cursor captured
+right after producing it, so ``state()`` always reflects what the
+CONSUMER has seen, never the producer's read-ahead.
 """
 
 from __future__ import annotations
@@ -37,6 +45,12 @@ class DataSetIterator:
 
     def reset(self) -> None:
         raise NotImplementedError
+
+    # Seekable cursor protocol (optional — ``util.durable.is_seekable``
+    # probes for the METHODS, so subclasses without a cursor simply don't
+    # define them): ``state() -> dict`` returns a JSON-serializable
+    # cursor; ``restore(state)`` on an equivalently built iterator
+    # reproduces the remaining batch stream exactly.
 
     @property
     def batch_size(self) -> int:
@@ -77,6 +91,15 @@ class ArrayDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         self._cursor = 0
 
+    def state(self) -> dict:
+        # the cursor indexes the CURRENT example order; a caller that
+        # shuffles per epoch must re-apply the same seeded shuffle before
+        # restore() for the stream to reproduce
+        return {"cursor": int(self._cursor)}
+
+    def restore(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])
+
     def shuffle(self, seed: Optional[int] = None) -> None:
         self._data.shuffle(seed)
         self._cursor = 0
@@ -106,6 +129,12 @@ class ListDataSetIterator(DataSetIterator):
 
     def reset(self) -> None:
         self._cursor = 0
+
+    def state(self) -> dict:
+        return {"cursor": int(self._cursor)}
+
+    def restore(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])
 
 
 class ExistingDataSetIterator(DataSetIterator):
@@ -170,6 +199,18 @@ class MultipleEpochsIterator(DataSetIterator):
         self._epoch = 0
         self.base.reset()
 
+    def seekable(self) -> bool:
+        """Only as seekable as the base — state() delegates to it."""
+        from ..util.durable import is_seekable
+        return is_seekable(self.base)
+
+    def state(self) -> dict:
+        return {"epoch": int(self._epoch), "base": self.base.state()}
+
+    def restore(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self.base.restore(state["base"])
+
 
 class SamplingDataSetIterator(DataSetIterator):
     """Random with-replacement samples from one DataSet (parity:
@@ -203,6 +244,17 @@ class SamplingDataSetIterator(DataSetIterator):
         self._count = 0
         self._rng = np.random.default_rng(self._seed)
 
+    def state(self) -> dict:
+        # bit_generator.state is a JSON-friendly dict (ints + strings), so
+        # restore reproduces the EXACT sample stream, not just the count
+        return {"count": int(self._count),
+                "rng": self._rng.bit_generator.state}
+
+    def restore(self, state: dict) -> None:
+        self._count = int(state["count"])
+        self._rng = np.random.default_rng()
+        self._rng.bit_generator.state = state["rng"]
+
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch + optional device placement.
@@ -218,6 +270,12 @@ class AsyncDataSetIterator(DataSetIterator):
     the epoch through the consumer. Producer errors are raised on the
     consumer as soon as they are observed (fail fast), not deferred until
     every already-staged batch has been drained.
+
+    Seekable: when the base iterator is, the producer tags every queued
+    batch with ``base.state()`` captured right after producing it, and the
+    consumer records the tag as each batch is handed out — so ``state()``
+    is always the cursor of the last CONSUMED batch (prefetched-but-unread
+    batches are replayed after a ``restore()``, never skipped).
     """
 
     def __init__(self, base: DataSetIterator, queue_size: int = 2,
@@ -241,12 +299,16 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _producer(self, pq) -> None:
         try:
+            seekable = self._base_seekable
             for ds in self.base:
                 if pq.stop.is_set():
                     return
+                # post-read cursor of THIS batch (the base's __iter__
+                # advances exactly one item per yield)
+                cursor = self.base.state() if seekable else None
                 if self.device_put:
                     ds = self._stage(ds)
-                if not pq.put(ds):
+                if not pq.put((ds, cursor)):
                     return
         except BaseException as e:  # surfaced on the consumer side
             pq.fail(e)
@@ -255,6 +317,15 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _start(self) -> None:
         from ..util.ingest import ProducerQueue
+        # the shared probe (both protocol halves + the base's own veto):
+        # a base with state() but no restore() must NOT be reported
+        # seekable — the failure would otherwise surface as an
+        # AttributeError at resume time
+        from ..util.durable import is_seekable
+        self._base_seekable = is_seekable(self.base)
+        # cursor of "nothing consumed yet" — captured BEFORE the producer
+        # thread starts racing ahead on the base
+        self._cursor = self.base.state() if self._base_seekable else None
         self._pq = ProducerQueue(self.queue_size)
         self._thread = threading.Thread(
             target=self._producer, args=(self._pq,), daemon=True)
@@ -278,7 +349,9 @@ class AsyncDataSetIterator(DataSetIterator):
     def next(self) -> DataSet:
         if not self.has_next():
             raise StopIteration
-        out, self._peek = self._peek, None
+        (out, cursor), self._peek = self._peek, None
+        if cursor is not None:
+            self._cursor = cursor
         return out
 
     def reset(self) -> None:
@@ -290,6 +363,28 @@ class AsyncDataSetIterator(DataSetIterator):
                 "blocked in next()?) — cannot safely reset")
         self._peek = None
         self.base.reset()
+        self._start()
+
+    def seekable(self) -> bool:
+        """The wrapper is only as seekable as its base
+        (``util.durable.is_seekable`` probes this)."""
+        return self._base_seekable
+
+    def state(self) -> dict:
+        """Cursor of the last batch the CONSUMER took (prefetched batches
+        still in the queue are not consumed and will be replayed)."""
+        if not self._base_seekable:
+            raise NotImplementedError(
+                f"base {type(self.base).__name__} has no seekable cursor")
+        return self._cursor
+
+    def restore(self, state: dict) -> None:
+        if not self._pq.drain_and_join(self._thread):
+            raise RuntimeError(
+                "async producer did not stop within 5s (base iterator "
+                "blocked in next()?) — cannot safely restore")
+        self._peek = None
+        self.base.restore(state)
         self._start()
 
     def close(self) -> None:

@@ -25,6 +25,15 @@ from ..datasets.iterator import DataSetIterator
 from .readers import RecordMetaData, RecordReader, SequenceRecordReader
 
 
+def _reader_seekable(reader) -> bool:
+    """Both halves of the cursor protocol: a reader with state() but no
+    restore() must not be reported seekable — the failure would otherwise
+    surface as an AttributeError at resume time. Delegates to the one
+    shared probe so the protocol cannot drift between call sites."""
+    from ..util.durable import is_seekable
+    return is_seekable(reader)
+
+
 def _to_float(v, label_map: Optional[Dict[str, int]] = None):
     if isinstance(v, str):
         if label_map is not None:
@@ -199,6 +208,22 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
         self._batch_num = 0
 
+    def seekable(self) -> bool:
+        return _reader_seekable(self.reader)
+
+    def state(self) -> dict:
+        # the lazily grown string→index label map is part of the cursor:
+        # without it a resumed run could assign different class indices to
+        # labels first seen after the restore point
+        return {"batch_num": int(self._batch_num),
+                "reader": self.reader.state(),
+                "label_map": dict(self._mapper.map)}
+
+    def restore(self, state: dict) -> None:
+        self._batch_num = int(state["batch_num"])
+        self.reader.restore(state["reader"])
+        self._mapper.map = dict(state.get("label_map", {}))
+
 
 class AlignmentMode:
     EQUAL_LENGTH = "equal_length"
@@ -319,6 +344,23 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
         if self.labels_reader is not None:
             self.labels_reader.reset()
+
+    def seekable(self) -> bool:
+        return _reader_seekable(self.reader) and (
+            self.labels_reader is None
+            or _reader_seekable(self.labels_reader))
+
+    def state(self) -> dict:
+        return {"reader": self.reader.state(),
+                "labels_reader": (None if self.labels_reader is None
+                                  else self.labels_reader.state()),
+                "label_map": dict(self._mapper.map)}
+
+    def restore(self, state: dict) -> None:
+        self.reader.restore(state["reader"])
+        if self.labels_reader is not None:
+            self.labels_reader.restore(state["labels_reader"])
+        self._mapper.map = dict(state.get("label_map", {}))
 
 
 class RecordReaderMultiDataSetIterator:

@@ -58,6 +58,12 @@ class RecordReader:
         raise NotImplementedError(
             f"{type(self).__name__} does not support metadata record loading")
 
+    # Seekable cursor protocol (optional — probed via the presence of the
+    # methods, see ``util.durable.is_seekable``): ``state() -> dict`` /
+    # ``restore(state)`` reproduce the remaining record stream exactly on
+    # an equivalently constructed reader. Every in-tree reader implements
+    # it; a custom reader without a cursor simply leaves them undefined.
+
     @property
     def labels(self) -> Optional[List[str]]:
         """Declared class-label ordering, if the source provides one."""
@@ -161,6 +167,12 @@ class CSVRecordReader(RecordReader):
     def reset(self) -> None:
         self._cursor = 0
 
+    def state(self) -> dict:
+        return {"cursor": int(self._cursor)}
+
+    def restore(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])
+
     def __len__(self) -> int:
         return len(self._records)
 
@@ -190,6 +202,12 @@ class CollectionRecordReader(RecordReader):
 
     def reset(self) -> None:
         self._cursor = 0
+
+    def state(self) -> dict:
+        return {"cursor": int(self._cursor)}
+
+    def restore(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])
 
     def __len__(self) -> int:
         return len(self._records)
@@ -225,6 +243,12 @@ class LineRecordReader(RecordReader):
 
     def reset(self) -> None:
         self._cursor = 0
+
+    def state(self) -> dict:
+        return {"cursor": int(self._cursor)}
+
+    def restore(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])
 
 
 class CSVSequenceRecordReader(SequenceRecordReader):
@@ -302,6 +326,24 @@ class CSVSequenceRecordReader(SequenceRecordReader):
         self._flat_seq = None
         self._flat_step = 0
         self._flat_read = False
+
+    def state(self) -> dict:
+        # the flat view's mid-sequence position rides along; _flat_seq
+        # itself is derived (sequences[cursor-1]) so only indices persist
+        return {"cursor": int(self._cursor),
+                "flat_step": (None if self._flat_seq is None
+                              else int(self._flat_step)),
+                "flat_read": bool(getattr(self, "_flat_read", False))}
+
+    def restore(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])
+        if state.get("flat_step") is None:
+            self._flat_seq, self._flat_step = None, 0
+        else:
+            self._flat_seq = [list(s)
+                              for s in self._sequences[self._cursor - 1]]
+            self._flat_step = int(state["flat_step"])
+        self._flat_read = bool(state.get("flat_read", False))
 
     def __len__(self) -> int:
         return len(self._sequences)

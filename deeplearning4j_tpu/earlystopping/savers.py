@@ -71,11 +71,31 @@ class InMemoryModelSaver(ModelSaver):
 
 
 class LocalFileModelSaver(ModelSaver):
-    """Writes checkpoint zips to a directory (parity: ``LocalFileModelSaver``)."""
+    """Writes checkpoint zips to a directory (parity: ``LocalFileModelSaver``).
+
+    Durability: each save stages through a temp file (the serializer's
+    tmp+rename), is manifest-validated BEFORE it replaces the published
+    name, and the previously published model rotates to ``*.prev.zip`` —
+    so a crash or torn write mid-``save_best_model`` can never leave the
+    best model unreadable. ``get_best_model``/``get_latest_model``
+    validate on read and fall back past an invalid file to the rotated
+    predecessor, the same newest-VALID-wins contract as
+    ``CheckpointRecovery.latest_valid()``.
+    """
 
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+        # staging leftovers from a process killed mid-_save would
+        # otherwise accumulate across crash/restart cycles forever
+        # (.wip_* is the serializer's own atomic-write temp, left when
+        # the kill lands inside save_model itself)
+        for name in os.listdir(directory):
+            if name.startswith((".staging_", ".wip_")):
+                try:
+                    os.remove(os.path.join(directory, name))
+                except OSError:
+                    pass
 
     @property
     def best_path(self) -> str:
@@ -85,19 +105,57 @@ class LocalFileModelSaver(ModelSaver):
     def latest_path(self) -> str:
         return os.path.join(self.directory, "latestModel.zip")
 
-    def save_best_model(self, net, score: float) -> None:
+    @staticmethod
+    def _prev(path: str) -> str:
+        return path[:-len(".zip")] + ".prev.zip"
+
+    def _save(self, net, path: str) -> None:
         from ..util import save_model
-        save_model(net, self.best_path)
+        from ..util.serialization import CheckpointInvalid, verify_checkpoint
+        staging = os.path.join(
+            self.directory,
+            f".staging_{os.getpid()}_{os.path.basename(path)}")
+        try:
+            save_model(net, staging)
+            verify_checkpoint(staging)      # never publish an invalid zip
+            if os.path.exists(path):
+                try:
+                    # rotate the outgoing model only while it is still a
+                    # valid fallback — never clobber a good .prev with a
+                    # corrupt current
+                    verify_checkpoint(path)
+                    os.replace(path, self._prev(path))
+                except CheckpointInvalid:
+                    pass
+            os.replace(staging, path)
+        finally:
+            if os.path.exists(staging):
+                try:
+                    os.remove(staging)
+                except OSError:
+                    pass
+
+    def save_best_model(self, net, score: float) -> None:
+        self._save(net, self.best_path)
 
     def save_latest_model(self, net, score: float) -> None:
-        from ..util import save_model
-        save_model(net, self.latest_path)
+        self._save(net, self.latest_path)
 
     def _load(self, path: str):
-        if not os.path.exists(path):
-            return None
+        import logging
         from ..util import load_model
-        return load_model(path)
+        from ..util.serialization import CheckpointInvalid, verify_checkpoint
+        for candidate in (path, self._prev(path)):
+            if not os.path.exists(candidate):
+                continue
+            try:
+                verify_checkpoint(candidate)
+                return load_model(candidate)
+            except Exception as e:
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "saved model %s unusable (%s: %s) — falling back",
+                    candidate, type(e).__name__, e)
+        return None
 
     def get_best_model(self):
         return self._load(self.best_path)

@@ -12,16 +12,32 @@ from .config import EarlyStoppingConfiguration, EarlyStoppingResult
 
 
 class BaseEarlyStoppingTrainer:
-    def __init__(self, config: EarlyStoppingConfiguration, net, train_data):
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_data,
+                 watchdog=None):
         self.config = config
         self.net = net
         self.train_data = train_data
+        # optional util.durable.StepWatchdog: petted once per minibatch,
+        # so a hung dispatch/ingest surfaces as a diagnosed timeout
+        # instead of a silent stall
+        self.watchdog = watchdog
 
     def fit(self) -> EarlyStoppingResult:
-        cfg = self.config
         net = self.net
         if net.params is None:
             net.init()
+        if self.watchdog is not None:
+            self.watchdog.arm()
+        try:
+            return self._fit_loop()
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+
+    def _fit_loop(self) -> EarlyStoppingResult:
+        from ..util import faults as _faults
+        cfg = self.config
+        net = self.net
         for c in cfg.epoch_termination_conditions:
             c.initialize()
         for c in cfg.iteration_termination_conditions:
@@ -44,7 +60,13 @@ class BaseEarlyStoppingTrainer:
                 self.train_data.reset()
             stop_iteration = None
             for x, y, mask in self._staged_batches():
+                _faults.check("training.step", {
+                    "model": type(net).__name__, "epoch": epoch,
+                    "iteration": net.iteration_count,
+                    "kind": "earlystopping"})
                 loss = float(self._fit_batch(x, y, mask))
+                if self.watchdog is not None:
+                    self.watchdog.pet()
                 for c in cfg.iteration_termination_conditions:
                     if c.terminate(loss):
                         stop_iteration = c

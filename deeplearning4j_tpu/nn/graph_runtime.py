@@ -288,7 +288,9 @@ class ComputationGraph:
         inputs = [jnp.asarray(x) for x in _as_list(
             inputs[0] if len(inputs) == 1 and isinstance(inputs[0], (list, tuple))
             else list(inputs))]
-        cache_key = f"output_train={train}"
+        # trace_env_key: flash-attention routing flags are read at trace
+        # time, so the compiled program is only reused while they match
+        cache_key = f"output_train={train}@{_xla.trace_env_key()}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
             @jax.jit
@@ -322,7 +324,8 @@ class ComputationGraph:
             # carried cache
             self._rnn_state = self._zero_rnn_carry(inputs[0].shape[0])
             self._rnn_steps_fed = 0
-        fn = self._jit_cache.get("rnn_time_step")
+        cache_key = f"rnn_time_step@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             @jax.jit
             def fn(params, states, inputs):
@@ -332,7 +335,7 @@ class ComputationGraph:
                                 if k in ("h", "c")}
                          for name, st in new_states.items()}
                 return [acts[n] for n in self.conf.network_outputs], carry
-            self._jit_cache["rnn_time_step"] = fn
+            self._jit_cache[cache_key] = fn
         outs, self._rnn_state = fn(self.params,
                                    self._states_map(self._rnn_state), inputs)
         # count only steps the cache actually absorbed (a rejected chunk
@@ -534,11 +537,17 @@ class ComputationGraph:
                        compiler_options=_xla.train_step_options())
 
     def _train_step(self):
-        fn = self._jit_cache.get("train_step")
+        # explicit override first (ParallelWrapper installs its sharded
+        # SPMD step here; an override is pinned, not trace-env-keyed)
+        fn = self._jit_cache.get("train_step_override")
+        if fn is not None:
+            return fn
+        cache_key = f"train_step@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             fn = _xla.retrace_guard(self._make_train_step(),
                                     "ComputationGraph.train_step")
-            self._jit_cache["train_step"] = fn
+            self._jit_cache[cache_key] = fn
         return fn
 
     def set_listeners(self, *listeners) -> None:
@@ -611,11 +620,12 @@ class ComputationGraph:
         if masks is not None:
             masks = [None if m is None else jnp.asarray(m)
                      for m in _as_list(masks)]
-        fn = self._jit_cache.get("train_scan")
+        cache_key = f"train_scan@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             fn = _xla.retrace_guard(self._make_train_scan(),
                                     "ComputationGraph.train_scan")
-            self._jit_cache["train_scan"] = fn
+            self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, losses = fn(
             self.params, self.updater_state, self._states_map(), xs, ys,
@@ -685,11 +695,12 @@ class ComputationGraph:
         if masks is not None:
             masks = [None if m is None else jnp.asarray(m)
                      for m in _as_list(masks)]
-        fn = self._jit_cache.get("train_repeat")
+        cache_key = f"train_repeat@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             fn = _xla.retrace_guard(self._make_train_repeat(),
                                     "ComputationGraph.train_repeat")
-            self._jit_cache["train_repeat"] = fn
+            self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, losses = fn(
             self.params, self.updater_state, self._states_map(), inputs,
@@ -819,7 +830,7 @@ class ComputationGraph:
         return loss
 
     def fit(self, data, labels=None, *, epochs: int = 1,
-            coalesce: Optional[int] = None) -> None:
+            coalesce: Optional[int] = None, session=None) -> None:
         """Train from (inputs, labels), a DataSet/MultiDataSet, or an iterator
         of either (parity: fit variants :614-760).
 
@@ -833,7 +844,7 @@ class ComputationGraph:
         if self.params is None:
             self.init()
         run_fit_loop(self, data, labels, None, epochs, coalesce,
-                     model_label="ComputationGraph")
+                     model_label="ComputationGraph", session=session)
 
     @staticmethod
     def _as_batches(data, labels=None, mask=None):
@@ -887,7 +898,10 @@ class ComputationGraph:
     def _vertex_input_activation(self, name: str, inputs: List[jax.Array]):
         """The (preprocessed) input activation a layer vertex sees, with all
         upstream vertices frozen in eval mode."""
-        fn = self._jit_cache.get(f"pre_acts_{name}")
+        # trace_env_key: frozen-vertex forwards trace the same attention
+        # routing flags as output()/fit — a flag flip must retrace here too
+        cache_key = f"pre_acts_{name}@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             @jax.jit
             def fn(params, states, inputs):
@@ -900,7 +914,7 @@ class ComputationGraph:
                         x,
                         minibatch_size=mbs[self.conf.vertex_inputs[name][0]])
                 return x
-            self._jit_cache[f"pre_acts_{name}"] = fn
+            self._jit_cache[cache_key] = fn
         return fn(self.params, self._states_map(), inputs)
 
 

@@ -245,7 +245,9 @@ class MultiLayerNetwork:
         train=True runs train-mode forward semantics (dropout active, BN
         batch statistics) without updating parameters."""
         x = jnp.asarray(x)
-        cache_key = f"output_train={train}"
+        # trace_env_key: flash-attention routing flags are read at trace
+        # time, so the compiled program is only reused while they match
+        cache_key = f"output_train={train}@{_xla.trace_env_key()}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
             @jax.jit
@@ -283,14 +285,15 @@ class MultiLayerNetwork:
             # carried cache
             self._rnn_state = self._zero_rnn_carry(x.shape[0])
             self._rnn_steps_fed = 0
-        fn = self._jit_cache.get("rnn_time_step")
+        cache_key = f"rnn_time_step@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             @jax.jit
             def fn(params, states, x):
                 out, new_states = self._forward(params, states, x,
                                                 train=False)
                 return out, self._extract_rnn_carry(new_states)
-            self._jit_cache["rnn_time_step"] = fn
+            self._jit_cache[cache_key] = fn
         out, self._rnn_state = fn(self.params,
                                   self._states_list(self._rnn_state), x)
         # count only steps the cache actually absorbed (a rejected chunk
@@ -409,11 +412,17 @@ class MultiLayerNetwork:
                        compiler_options=_xla.train_step_options())
 
     def _train_step(self):
-        fn = self._jit_cache.get("train_step")
+        # explicit override first (ParallelWrapper installs its sharded
+        # SPMD step here; an override is pinned, not trace-env-keyed)
+        fn = self._jit_cache.get("train_step_override")
+        if fn is not None:
+            return fn
+        cache_key = f"train_step@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             fn = _xla.retrace_guard(self._make_train_step(),
                                     "MultiLayerNetwork.train_step")
-            self._jit_cache["train_step"] = fn
+            self._jit_cache[cache_key] = fn
         return fn
 
     def _make_train_scan(self):
@@ -465,11 +474,12 @@ class MultiLayerNetwork:
         k = xs.shape[0]
         if masks is not None:
             masks = jnp.asarray(masks)
-        fn = self._jit_cache.get("train_scan")
+        cache_key = f"train_scan@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             fn = _xla.retrace_guard(self._make_train_scan(),
                                     "MultiLayerNetwork.train_scan")
-            self._jit_cache["train_scan"] = fn
+            self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         states = self._states_list()
         params, opt_state, new_states, losses = fn(
@@ -535,11 +545,12 @@ class MultiLayerNetwork:
         self._reject_tbptt(x, "fit_repeated")
         if mask is not None:
             mask = jnp.asarray(mask)
-        fn = self._jit_cache.get("train_repeat")
+        cache_key = f"train_repeat@{_xla.trace_env_key()}"
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
             fn = _xla.retrace_guard(self._make_train_repeat(),
                                     "MultiLayerNetwork.train_repeat")
-            self._jit_cache["train_repeat"] = fn
+            self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         params, opt_state, new_states, losses = fn(
             self.params, self.updater_state, self._states_list(), x, y,
@@ -573,7 +584,7 @@ class MultiLayerNetwork:
         self.listeners.append(listener)
 
     def fit(self, data, labels=None, *, epochs: int = 1, mask=None,
-            coalesce: Optional[int] = None) -> None:
+            coalesce: Optional[int] = None, session=None) -> None:
         """Train. `data` may be:
           - (features, labels) arrays (`labels=None` form passes labels here),
           - a DataSet (has .features/.labels),
@@ -588,13 +599,15 @@ class MultiLayerNetwork:
         dispatch — opt-in, because the fused path derives per-step rng
         differently. Epoch resets happen lazily at the START of each
         subsequent epoch, so the final epoch never restarts the producer
-        just to throw the work away.
+        just to throw the work away. ``session`` attaches a
+        ``util.durable.DurableSession`` (cursor tracking, async
+        checkpoints, preemption drain, watchdog).
         """
         from ..util.ingest import run_fit_loop
         if self.params is None:
             self.init()
         run_fit_loop(self, data, labels, mask, epochs, coalesce,
-                     model_label="MultiLayerNetwork")
+                     model_label="MultiLayerNetwork", session=session)
 
     @staticmethod
     def _as_batches(data, labels=None, mask=None):
@@ -733,7 +746,9 @@ class MultiLayerNetwork:
 
     def _activation_upto(self, x, layer_idx: int):
         """Input activations for layer `layer_idx` (frozen earlier layers)."""
-        fn_key = f"acts_upto_{layer_idx}"
+        # trace_env_key: frozen-layer forwards trace the same attention
+        # routing flags as output()/fit — a flag flip must retrace here too
+        fn_key = f"acts_upto_{layer_idx}@{_xla.trace_env_key()}"
         fn = self._jit_cache.get(fn_key)
         if fn is None:
             @jax.jit

@@ -116,6 +116,27 @@ def global_mesh(axes: Optional[Dict[str, int]] = None) -> Mesh:
     return Mesh(arr, names)
 
 
+def agree_on_digest(digest: str, *, allgather=None) -> bool:
+    """Pre-commit barrier for the multi-process dp path: every host
+    presents its training-state digest (``util.durable.params_digest``)
+    and the checkpoint commits only if ALL hosts agree — a diverged
+    replica (bad host, dropped collective) must not publish its state as
+    THE recovery point.
+
+    ``allgather`` is injectable for tests; the default uses
+    ``multihost_utils.process_allgather`` (single-process: trivially
+    True).
+    """
+    local = np.frombuffer(bytes.fromhex(digest), dtype=np.uint8)
+    if allgather is None:
+        if jax.process_count() == 1:
+            return True
+        from jax.experimental import multihost_utils
+        allgather = multihost_utils.process_allgather
+    world = np.atleast_2d(np.asarray(allgather(local)))
+    return bool((world == world[0]).all())
+
+
 def host_local_batch(mesh: Mesh, *arrays, axis: str = "data"):
     """Assemble global device arrays from per-process host-local batches.
 

@@ -105,9 +105,10 @@ class ParallelWrapper:
             stats = TrainingStats()
         self.stats = stats or None
         if self.averaging_frequency == 1:
-            # install the sharded step into the net's jit cache: net.fit then
-            # runs SPMD transparently
-            net._jit_cache["train_step"] = self._make_sync_step()
+            # install the sharded step as the net's pinned train-step
+            # override: net.fit then runs SPMD transparently (the
+            # override slot bypasses the trace-env cache keying)
+            net._jit_cache["train_step_override"] = self._make_sync_step()
         elif self.averaging_frequency < 1:
             raise ValueError("averaging_frequency must be >= 1")
 

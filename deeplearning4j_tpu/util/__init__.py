@@ -11,7 +11,14 @@ from . import metrics
 from . import tracing
 from .metrics import REGISTRY, MetricsRegistry
 from .tracing import Tracer
+from .durable import (AsyncCheckpointWriter, CheckpointStore,
+                      DurableSession, DurableTrainer, PreemptionHandler,
+                      StepWatchdog, TrainingState, WatchdogTimeout,
+                      is_seekable)
 
 __all__ = ["ModelSerializer", "save_model", "load_model",
            "CheckpointRecovery", "RecoverableTrainer", "profiling",
-           "metrics", "tracing", "REGISTRY", "MetricsRegistry", "Tracer"]
+           "metrics", "tracing", "REGISTRY", "MetricsRegistry", "Tracer",
+           "AsyncCheckpointWriter", "CheckpointStore", "DurableSession",
+           "DurableTrainer", "PreemptionHandler", "StepWatchdog",
+           "TrainingState", "WatchdogTimeout", "is_seekable"]

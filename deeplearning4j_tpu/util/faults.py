@@ -14,6 +14,11 @@ Known sites (grep for ``faults.check``):
 - ``"storage.post"``       — RemoteUIStatsStorageRouter HTTP round-trip
 - ``"serving.infer"``      — the inference server's batched model call
 - ``"recovery.restore"``   — checkpoint load during recovery
+- ``"training.step"``      — once per dispatched step in the shared fit
+  loop (``util.ingest.run_fit_loop``) and the early-stopping trainers,
+  BEFORE the dispatch; chaos tests script kills/hangs at exact step
+  boundaries here (raise = clean crash, ``os._exit`` hook = hard kill,
+  ``os.kill(os.getpid(), SIGTERM)`` hook = preemption signal)
 
 Usage::
 

@@ -49,6 +49,7 @@ from typing import Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from . import faults as _faults
 from . import metrics as _metrics
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -495,7 +496,8 @@ def coalesced(batches: Iterable[Tuple], k: int) -> Iterator[Tuple[str, Tuple]]:
 # ----------------------------------------------------------------------
 
 def run_fit_loop(net, data, labels, mask, epochs: int,
-                 coalesce: Optional[int], *, model_label: str) -> None:
+                 coalesce: Optional[int], *, model_label: str,
+                 session=None) -> None:
     """The dispatch-asynchronous epoch loop behind both runtimes' ``fit``.
 
     Per epoch: lazily reset the source (at epoch START, so the final
@@ -506,6 +508,15 @@ def run_fit_loop(net, data, labels, mask, epochs: int,
     same-shape runs through ``fit_scan``; with listeners attached it
     stays off unless the caller passed ``coalesce`` explicitly (listeners
     get replayed host scores there, i.e. per-step host values).
+
+    Every dispatched step first passes the ``"training.step"`` fault seam
+    (chaos tests script kills/hangs at exact step boundaries). With a
+    ``session`` (``util.durable.DurableSession``) attached, the loop also
+    taps the batch stream for data-source cursors (BEFORE staging, so
+    cursors are recorded in production order), reports each step for
+    checkpointing/watchdog petting, and — when the session asks to stop
+    (preemption, max_steps) — drains the in-flight window and returns
+    cleanly WITHOUT counting the partial epoch.
     """
     single = (labels is not None or hasattr(data, "shape")
               or hasattr(data, "features")
@@ -523,15 +534,22 @@ def run_fit_loop(net, data, labels, mask, epochs: int,
     elif net.listeners and coalesce is None:
         k = 0
     gap_hist = host_gap_histogram()
+    # a session resuming a mid-epoch cursor must not "revive" the source
+    # on its first epoch: a cursor at the exact end of the data means
+    # zero batches remain, not restart-from-scratch
+    revive_ok = not (session is not None
+                     and getattr(session, "resuming", False))
     for epoch in range(epochs):
         if hasattr(data, "reset") and (
-                epoch > 0 or (hasattr(data, "has_next")
+                epoch > 0 or (revive_ok and hasattr(data, "has_next")
                               and not data.has_next())):
             data.reset()
         for l in net.listeners:
             l.on_epoch_start(net, net.epoch_count)
         window = InflightWindow()
         source = net._as_batches(data, labels, mask)
+        if session is not None:
+            source = session.tap(source, data)
         staged = None
         if not single and staging_enabled() and not already_staged(data):
             staged = stage(source, stage_name="fit",
@@ -539,22 +557,37 @@ def run_fit_loop(net, data, labels, mask, epochs: int,
             source = staged
         n_batches = 0
         t_prev = None
+        stopped = False
         try:
             for kind, payload in coalesced(source, k):
                 t_now = time.perf_counter()
                 if t_prev is not None:
                     gap_hist.observe(t_now - t_prev, model=model_label)
+                _faults.check("training.step", {
+                    "model": model_label, "epoch": net.epoch_count,
+                    "iteration": net.iteration_count, "kind": kind})
                 if kind == "scan":
                     xs, ys = payload
                     window.push(net.fit_scan(xs, ys))
-                    n_batches += int(xs.shape[0])
+                    consumed = int(xs.shape[0])
                 else:
                     window.push(net.fit_batch(*payload))
-                    n_batches += 1
+                    consumed = 1
+                n_batches += consumed
+                if session is not None and not session.on_step(net,
+                                                               consumed):
+                    # clean stop (preemption / max_steps): every
+                    # dispatched step must land before the caller
+                    # checkpoints the stop instant
+                    window.drain()
+                    stopped = True
+                    break
                 t_prev = time.perf_counter()
         finally:
             if staged is not None:
                 staged.close()
+        if stopped:
+            return          # partial epoch: no epoch_end, no count bump
         if n_batches == 0 and epoch > 0:
             raise ValueError(
                 f"epoch {epoch} yielded no batches — the data iterator is "
@@ -563,3 +596,5 @@ def run_fit_loop(net, data, labels, mask, epochs: int,
         for l in net.listeners:
             l.on_epoch_end(net, net.epoch_count)
         net.epoch_count += 1
+        if session is not None:
+            session.on_epoch_boundary(net)

@@ -7,14 +7,17 @@ framework owns the story: atomic rolling checkpoints (params + updater
 state + counters via ``ModelSerializer``) and a ``fit`` wrapper that
 resumes from the newest checkpoint, skipping completed epochs.
 
-Granularity contract: epoch-boundary checkpoints (``checkpoint_*``) are
-the automatic recovery points — ``RecoverableTrainer.fit()`` resumes from
-the newest one and re-runs nothing. Mid-epoch ``periodic_*`` checkpoints
-(every ``frequency`` iterations) exist for MANUAL recovery after a long
-partial epoch; resuming one re-runs the partial epoch from its start, so
-its first batches are applied twice — exact mid-epoch replay would need a
-deterministic, skippable data source, which ``fit`` cannot assume of an
-arbitrary iterator.
+Exactness contract: for a SEEKABLE data source (the ``state()``/
+``restore()`` cursor protocol every in-tree iterator implements — see
+``util.durable``), ``RecoverableTrainer`` writes mid-epoch
+:class:`~deeplearning4j_tpu.util.durable.TrainingState` snapshots that
+carry the data-source cursor, and resume is bit-exact AT ANY STEP: the
+restored run replays zero batches, skips none, and reproduces the
+uninterrupted run's loss trajectory and final params bit-for-bit (pinned
+by the kill-at-every-seam chaos tests in ``tests/test_durable.py``).
+Legacy ``periodic_*``/``checkpoint_*`` zips are still written for
+compatibility; non-seekable sources fall back to epoch-boundary resume
+(the newest ``checkpoint_*``, re-running nothing).
 """
 
 from __future__ import annotations
@@ -121,43 +124,116 @@ class CheckpointRecovery:
 
 class RecoverableTrainer:
     """``fit`` with automatic resume (the TPU-native answer to Spark task
-    retry): restores the newest checkpoint on construction, then trains
-    the remaining epochs, checkpointing every ``frequency`` iterations and
-    at each epoch end."""
+    retry): restores the newest recovery point on construction, then
+    trains the remaining epochs, checkpointing every ``frequency``
+    iterations and at each epoch end.
+
+    Recovery points, newest-wins: durable ``state_*`` snapshots
+    (``util.durable.TrainingState`` — params + updater + RNG counters +
+    data cursor; exact at any step) and legacy epoch-boundary
+    ``checkpoint_*`` zips. A mid-epoch snapshot resumes EXACTLY when
+    ``fit`` is then given a seekable data source: the cursor is restored
+    and the partial epoch continues from the precise batch where the
+    process died."""
 
     def __init__(self, net, checkpoint_dir: str, *, frequency: int = 100,
                  keep: int = 2):
+        from . import durable as _durable
         self.recovery = CheckpointRecovery(checkpoint_dir, keep=keep)
-        restored = self.recovery.restore()
+        self.store = _durable.CheckpointStore(checkpoint_dir, keep=keep)
+        self._resume_cursor: Optional[dict] = None
+        restored = None
+        # every candidate, newest-wins by the (epoch, iteration) in its
+        # NAME (no model deserialization just to compare recency; durable
+        # snapshots win ties — they carry the cursor). A candidate that
+        # validates but fails to load falls back to the next older one
+        # ACROSS kinds — never silently past a newer valid snapshot.
+        for _, kind, path in self._recovery_points():
+            try:
+                if kind == "durable":
+                    loaded = self.store.load(path)
+                    restored = loaded.net
+                    self._resume_cursor = loaded.cursor
+                else:
+                    verify_checkpoint(path)
+                    _faults.check("recovery.restore", {"path": path})
+                    restored = load_model(path, load_updater=True)
+                break
+            except Exception as e:
+                self._resume_cursor = None
+                logger.warning(
+                    "recovery point %s unusable (%s: %s) — falling back "
+                    "to the next older one", path, type(e).__name__, e)
         if restored is not None:
             net = restored
         self.net = net
         self.frequency = max(1, int(frequency))
         self.resumed = restored is not None
 
+    def _recovery_points(self) -> List[tuple]:
+        """All recovery points in the directory, newest first:
+        ``((epoch, iter, durable?), kind, path)`` for durable ``state_*``
+        snapshot dirs and legacy boundary ``checkpoint_*`` zips."""
+        from . import durable as _durable
+        points = []
+        for name in self.store.snapshots():
+            m = _durable._STATE_RE.match(name)
+            points.append(((int(m.group(1)), int(m.group(2)), 1),
+                           "durable",
+                           os.path.join(self.store.directory, name)))
+        for name in self.recovery._checkpoints("boundary"):
+            e, i = self._parse(name)
+            points.append(((e, i, 0), "legacy",
+                           os.path.join(self.recovery.directory, name)))
+        points.sort(reverse=True)
+        return points
+
+    @staticmethod
+    def _parse(path: str) -> tuple:
+        m = _KIND_RES["boundary"].match(os.path.basename(path))
+        return tuple(map(int, m.groups())) if m else (-1, -1)
+
     def fit(self, data, labels=None, *, epochs: int = 1, mask=None):
         """Train until ``epochs`` TOTAL epochs are recorded on the model
-        (a resumed model with epoch_count >= epochs trains zero epochs)."""
+        (a resumed model with epoch_count >= epochs trains zero epochs).
+        A mid-epoch resume restores the data cursor first — the source
+        must then be seekable (every in-tree iterator is)."""
+        from . import durable as _durable
         net = self.net
-        kwargs = {}
-        if mask is not None:
-            # ComputationGraph.fit has no mask kwarg (masks ride in DataSets)
-            import inspect
-            if "mask" not in inspect.signature(net.fit).parameters:
+        kwargs = _durable.mask_fit_kwargs(net, mask)
+        resumed_mid = self._resume_cursor is not None
+        if resumed_mid:
+            if not _durable.is_seekable(data):
                 raise ValueError(
-                    "mask kwarg is only supported for MultiLayerNetwork; "
-                    "pass masks via DataSet batches for graphs")
-            kwargs["mask"] = mask
+                    "resuming a mid-epoch snapshot needs a seekable data "
+                    f"source (state()/restore()) — got "
+                    f"{type(data).__name__}")
+            data.restore(self._resume_cursor)
+            self._resume_cursor = None
         hook = _CheckpointListener(self.recovery, net, self.frequency)
         net.add_listener(hook)
+        seekable = _durable.is_seekable(data)
+        writer = (_durable.AsyncCheckpointWriter(self.store)
+                  if seekable else None)
         try:
             while net.epoch_count < epochs:
+                if seekable:
+                    # exact mid-epoch recovery points (cursor-carrying
+                    # TrainingState snapshots) ride along with the legacy
+                    # periodic zips, written off the critical path
+                    kwargs["session"] = _durable.DurableSession(
+                        net, self.store, data=data,
+                        frequency=self.frequency, writer=writer,
+                        resuming=resumed_mid)
+                    resumed_mid = False
                 net.fit(data, labels, epochs=1, **kwargs)
                 self.recovery.save(net, kind="boundary")
                 if hasattr(data, "reset"):
                     data.reset()
         finally:
             net.listeners.remove(hook)
+            if writer is not None:
+                writer.close()
         return net
 
 

@@ -28,9 +28,21 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Iterator, Optional, Tuple, Type
+import weakref
+from typing import Callable, Dict, Iterator, Optional, Tuple, Type
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+# Every constructed CircuitBreaker registers here (weakly), so diagnostic
+# dumps — chiefly util.durable.StepWatchdog's no-progress report — can
+# name each live breaker's current state without threading references.
+_live_breakers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def breaker_states() -> Dict[str, str]:
+    """Name → state of every live :class:`CircuitBreaker` in the process."""
+    return {b.name: b.state for b in sorted(
+        list(_live_breakers), key=lambda b: b.name)}
 
 
 class ResilienceError(Exception):
@@ -231,6 +243,7 @@ class CircuitBreaker:
         self._pending_transitions: list = []
         self.trips = 0          # times the breaker went CLOSED/HALF_OPEN→OPEN
         self.rejected = 0       # calls refused while OPEN
+        _live_breakers.add(self)
 
     def _set_state(self, new: str) -> None:
         """Must hold self._lock; queues the transition for hooks."""

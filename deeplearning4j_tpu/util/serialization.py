@@ -240,9 +240,13 @@ class ModelSerializer:
     """Static save/restore (parity: ``ModelSerializer``)."""
 
     @staticmethod
-    def write_model(net, path: str, save_updater: bool = True) -> None:
+    def write_model(net, path: str, save_updater: bool = True,
+                    model_class: Optional[str] = None) -> None:
         """Write network → zip. `net` is a MultiLayerNetwork or
-        ComputationGraph (anything with .conf/.params/.state/.updater_state)."""
+        ComputationGraph (anything with .conf/.params/.state/.updater_state).
+        ``model_class`` overrides the recorded class name — used by
+        ``util.durable`` when serializing a detached snapshot shim whose
+        Python type is not the runtime network class."""
         arrays: Dict[str, np.ndarray] = {}
         params = jax.device_get(net.params)
         _flatten("params", params, arrays)
@@ -254,7 +258,7 @@ class ModelSerializer:
         np.savez(buf, **arrays)
         training_state = {
             "format_version": _FORMAT_VERSION,
-            "model_class": type(net).__name__,
+            "model_class": model_class or type(net).__name__,
             "iteration_count": getattr(net, "iteration_count", 0),
             "epoch_count": getattr(net, "epoch_count", 0),
             "update_count": getattr(net, "_update_count", 0),

@@ -63,6 +63,21 @@ def train_step_options() -> Optional[Dict[str, str]]:
 
 
 # ----------------------------------------------------------------------
+# trace-time routing flags
+# ----------------------------------------------------------------------
+
+def trace_env_key() -> str:
+    """Cache-key suffix for jitted step functions capturing every env
+    flag that is read at TRACE time and baked into the compiled program
+    (currently the flash-attention routing flags). The runtimes append it
+    to their ``_jit_cache`` keys, so flipping ``DL4JTPU_FLASH_ATTENTION``
+    / ``DL4JTPU_FLASH_BWD`` takes effect on the next call — a fresh trace
+    under the new routing — without manual jit-cache clearing."""
+    return (f"fa={os.environ.get('DL4JTPU_FLASH_ATTENTION', 'auto')}"
+            f"|fabwd={os.environ.get('DL4JTPU_FLASH_BWD', 'pallas')}")
+
+
+# ----------------------------------------------------------------------
 # retrace guard
 # ----------------------------------------------------------------------
 

@@ -1,0 +1,159 @@
+"""Reusable fork-and-kill harness for preemption/resume chaos tests.
+
+A child python process runs a small deterministic training job under
+``DurableTrainer``; a scripted fault at the ``"training.step"`` seam
+kills it at an EXACT step boundary — ``os._exit`` (hard kill, nothing
+drains) or self-``SIGTERM`` (the preemption handler drains the in-flight
+window and writes a final snapshot). The parent then resumes from the
+same checkpoint directory (fresh process = fresh jit caches, the honest
+preemption scenario) and the calling test compares the resumed run's
+loss trajectory and final params bit-for-bit against an uninterrupted
+reference.
+
+Child protocol: ``python _kill_harness.py '<json config>'``; the child
+writes ``result.json`` (iteration/epoch counters, per-iteration scores,
+sha256 param digest) into the checkpoint directory on clean completion.
+
+Config keys: checkpoint_dir, total_epochs, frequency,
+kill_mode (None | "exit" | "sigterm"), kill_at_iteration, seed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HARNESS = os.path.abspath(__file__)
+
+# deterministic toy problem shared by child and reference runs
+N_BATCHES = 6
+BATCH = 8
+FEATURES = 5
+CLASSES = 3
+
+
+def build_net(seed: int = 7):
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater("adam")
+            .learning_rate(0.01).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=CLASSES, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(FEATURES)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_iterator(seed: int = 7):
+    import numpy as np
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N_BATCHES * BATCH, FEATURES)).astype(np.float32)
+    y = np.eye(CLASSES, dtype=np.float32)[
+        rng.integers(0, CLASSES, N_BATCHES * BATCH)]
+    return ListDataSetIterator(
+        [DataSet(x[i * BATCH:(i + 1) * BATCH], y[i * BATCH:(i + 1) * BATCH])
+         for i in range(N_BATCHES)], batch_size=BATCH)
+
+
+def params_sha(net) -> str:
+    import hashlib
+    import jax
+    import numpy as np
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(net.params)):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def run_child(config: dict, timeout: float = 120.0):
+    """Spawn the harness as a subprocess; returns (returncode, stderr)."""
+    repo_root = os.path.dirname(os.path.dirname(HARNESS))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    proc = subprocess.run(
+        [sys.executable, HARNESS, json.dumps(config)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=repo_root)
+    return proc.returncode, proc.stderr
+
+
+def _child_main(config: dict) -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)   # match the test processes
+
+    import signal
+
+    from deeplearning4j_tpu.util import faults
+    from deeplearning4j_tpu.util.durable import DurableTrainer
+
+    directory = config["checkpoint_dir"]
+    kill_mode = config.get("kill_mode")
+    kill_at = config.get("kill_at_iteration")
+
+    trainer = DurableTrainer(
+        build_net(config.get("seed", 7)), directory,
+        frequency=config.get("frequency", 2), handle_signals=True,
+        async_writes=config.get("async", True))
+
+    scores = []
+
+    class _Collect:
+        def iteration_done(self, model, iteration, score):
+            scores.append(float(score))
+
+        def on_epoch_start(self, *a):
+            pass
+
+        def on_epoch_end(self, *a):
+            pass
+
+        def on_forward_pass(self, *a):
+            pass
+
+        def on_gradient_calculation(self, *a):
+            pass
+
+        def on_backward_pass(self, *a):
+            pass
+
+    trainer.net.add_listener(_Collect())
+
+    plan = faults.FaultPlan()
+    if kill_mode:
+        def kill(payload):
+            # the seam fires BEFORE dispatching the (iteration+1)-th step:
+            # iterations 1..kill_at are applied, nothing after
+            if payload["iteration"] == kill_at:
+                if kill_mode == "exit":
+                    os._exit(9)              # hard kill: nothing drains
+                os.kill(os.getpid(), signal.SIGTERM)
+        plan.always("training.step", exc=kill)
+
+    with plan.active():
+        trainer.fit(build_iterator(config.get("seed", 7)),
+                    epochs=config["total_epochs"])
+
+    result = {
+        "iteration_count": trainer.net.iteration_count,
+        "epoch_count": trainer.net.epoch_count,
+        "preempted": trainer.preempted,
+        "resumed": trainer.resumed,
+        "scores": scores,
+        "params_sha": params_sha(trainer.net),
+    }
+    with open(os.path.join(directory, "result.json"), "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    _child_main(json.loads(sys.argv[1]))

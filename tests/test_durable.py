@@ -1,0 +1,796 @@
+"""Durable-training chaos suite: kill ``fit()`` at every seam and prove
+bit-exact resume.
+
+Acceptance pins (ISSUE 5): kills at a mid-epoch step boundary, during a
+checkpoint write, and via SIGTERM with dispatches in flight all resume to
+the SAME loss trajectory and final params as an uninterrupted run; a
+torn/partial commit is never restorable (restore falls back to the
+previous valid state); the async writer keeps at most one write
+outstanding; the step watchdog dumps queue depths, breaker states and the
+active span.
+
+Everything in-process runs on the deterministic ``training.step`` /
+``checkpoint.write`` fault seams (no sleeps); the subprocess cases use
+``tests/_kill_harness.py`` (fresh process = fresh jit caches — the honest
+preemption scenario).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import _kill_harness as harness
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.datasets.iterator import (AsyncDataSetIterator,
+                                                  ExistingDataSetIterator,
+                                                  ListDataSetIterator,
+                                                  MultipleEpochsIterator,
+                                                  SamplingDataSetIterator)
+from deeplearning4j_tpu.util import faults
+from deeplearning4j_tpu.util.durable import (AsyncCheckpointWriter,
+                                             CheckpointStore,
+                                             DurableSession, DurableTrainer,
+                                             PreemptionHandler, StepWatchdog,
+                                             TrainingState, WatchdogTimeout,
+                                             is_seekable, params_digest)
+from deeplearning4j_tpu.util.serialization import CheckpointInvalid
+
+
+def _scores_listener(sink):
+    class _L:
+        def iteration_done(self, model, iteration, score):
+            sink.append(float(score))
+
+        def on_epoch_start(self, *a):
+            pass
+
+        def on_epoch_end(self, *a):
+            pass
+
+        def on_forward_pass(self, *a):
+            pass
+
+        def on_gradient_calculation(self, *a):
+            pass
+
+        def on_backward_pass(self, *a):
+            pass
+    return _L()
+
+
+def _leaves(net):
+    import jax
+    return [np.asarray(l) for l in
+            jax.tree_util.tree_leaves(jax.device_get(net.params))]
+
+
+def _reference_run(epochs):
+    """Uninterrupted run on the harness's toy problem."""
+    net = harness.build_net()
+    scores = []
+    net.add_listener(_scores_listener(scores))
+    net.fit(harness.build_iterator(), epochs=epochs)
+    return net, scores
+
+
+# ----------------------------------------------------------------------
+# seekable protocol
+# ----------------------------------------------------------------------
+
+class TestSeekableSources:
+    def _batches(self, n=6):
+        return [DataSet(np.full((2, 3), i, np.float32),
+                        np.ones((2, 1), np.float32)) for i in range(n)]
+
+    def test_list_iterator_roundtrip(self):
+        it = ListDataSetIterator(self._batches(), batch_size=2)
+        assert is_seekable(it)
+        it.next(), it.next()
+        st = it.state()
+        rest = [it.next().features[0, 0] for _ in range(4)]
+        it2 = ListDataSetIterator(self._batches(), batch_size=2)
+        it2.restore(st)
+        assert [it2.next().features[0, 0] for _ in range(4)] == rest
+        assert not it2.has_next()
+
+    def test_async_wrapper_half_protocol_base_not_seekable(self):
+        """A base with state() but no restore() must be reported
+        non-seekable up front — not blow up with an AttributeError at
+        resume time, when the snapshot is already relied upon."""
+        batches = self._batches(3)
+
+        class HalfSeekable:
+            batch_size = 2
+
+            def __init__(self):
+                self.pos = 0
+
+            def __iter__(self):
+                while self.pos < len(batches):
+                    b = batches[self.pos]
+                    self.pos += 1
+                    yield b
+
+            def has_next(self):
+                return self.pos < len(batches)
+
+            def reset(self):
+                self.pos = 0
+
+            def state(self):
+                return {"pos": self.pos}
+            # no restore(): only half the cursor protocol
+
+        it = AsyncDataSetIterator(HalfSeekable())
+        try:
+            assert not it.seekable()
+            assert not is_seekable(it)
+        finally:
+            it.close()
+
+    def test_multiple_epochs_over_non_seekable_base_not_seekable(self):
+        """MultipleEpochsIterator's state() delegates to the base, so a
+        cursor-less base must veto seekability — not crash tap() with an
+        AttributeError mid-training."""
+        it = MultipleEpochsIterator(
+            2, ExistingDataSetIterator(self._batches(3)))
+        assert not is_seekable(it)
+        it2 = MultipleEpochsIterator(
+            2, ListDataSetIterator(self._batches(3), batch_size=2))
+        assert is_seekable(it2)
+
+    def test_multiple_epochs_cursor_carries_epoch(self):
+        it = MultipleEpochsIterator(
+            2, ListDataSetIterator(self._batches(3), batch_size=2))
+        for _ in range(4):        # one epoch + one batch of the second
+            it.next()
+        st = it.state()
+        assert st["epoch"] == 1
+        rest = [it.next().features[0, 0] for _ in range(2)]
+        it2 = MultipleEpochsIterator(
+            2, ListDataSetIterator(self._batches(3), batch_size=2))
+        it2.restore(st)
+        assert [it2.next().features[0, 0] for _ in range(2)] == rest
+        assert not it2.has_next()
+
+    def test_sampling_iterator_restores_exact_rng_stream(self):
+        data = DataSet(np.arange(40, dtype=np.float32).reshape(20, 2),
+                       np.ones((20, 1), np.float32))
+        it = SamplingDataSetIterator(data, batch_size=4, total_batches=6,
+                                     seed=3)
+        it.next(), it.next()
+        st = it.state()
+        rest = [np.asarray(it.next().features) for _ in range(4)]
+        it2 = SamplingDataSetIterator(data, batch_size=4, total_batches=6,
+                                      seed=3)
+        it2.restore(st)
+        for want in rest:
+            np.testing.assert_array_equal(
+                np.asarray(it2.next().features), want)
+        assert not it2.has_next()
+
+    def test_async_wrapper_tracks_consumer_not_prefetch(self):
+        base = ListDataSetIterator(self._batches(8), batch_size=2)
+        it = AsyncDataSetIterator(base, queue_size=4)
+        consumed = [it.next() for _ in range(3)]
+        st = it.state()                    # prefetch is ahead of this
+        assert st == {"cursor": 3}
+        rest = [it.next().features[0, 0] for _ in range(5)]
+        it2 = AsyncDataSetIterator(
+            ListDataSetIterator(self._batches(8), batch_size=2),
+            queue_size=4)
+        it2.restore(st)
+        assert [it2.next().features[0, 0] for _ in range(5)] == rest
+        assert not it2.has_next()
+        assert consumed[0].features[0, 0] == 0.0
+
+    def test_record_reader_iterator_keeps_label_map(self):
+        from deeplearning4j_tpu.datavec.iterator import \
+            RecordReaderDataSetIterator
+        from deeplearning4j_tpu.datavec.readers import CollectionRecordReader
+
+        records = [[float(i), ["a", "b", "c"][i % 3]] for i in range(12)]
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(records), batch_size=4, label_index=1,
+            num_classes=3)
+        it.next()
+        st = it.state()
+        assert st["label_map"]            # grown lazily so far
+        rest = [np.asarray(it.next().labels) for _ in range(2)]
+        it2 = RecordReaderDataSetIterator(
+            CollectionRecordReader(records), batch_size=4, label_index=1,
+            num_classes=3)
+        it2.restore(st)
+        for want in rest:
+            np.testing.assert_array_equal(np.asarray(it2.next().labels),
+                                          want)
+
+
+# ----------------------------------------------------------------------
+# commit protocol
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestCommitProtocol:
+    def _two_snapshots(self, tmp_path):
+        net = harness.build_net()
+        it = harness.build_iterator()
+        store = CheckpointStore(str(tmp_path), keep=5)
+        net.fit(it, epochs=1)
+        first = store.save(TrainingState.capture(net, cursor=None))
+        it.reset()
+        net.fit(it, epochs=1)
+        second = store.save(TrainingState.capture(net,
+                                                  cursor={"cursor": 6}))
+        return store, net, first, second
+
+    def test_roundtrip_restores_counters_cursor_digest(self, tmp_path):
+        store, net, first, second = self._two_snapshots(tmp_path)
+        loaded = store.load_latest()
+        assert loaded.path == second
+        assert loaded.cursor == {"cursor": 6}
+        assert loaded.epoch_count == net.epoch_count
+        assert loaded.iteration_count == net.iteration_count
+        import jax
+        assert loaded.digest == params_digest(
+            jax.device_get(net.params), jax.device_get(net.updater_state),
+            net._update_count)
+        for a, b in zip(_leaves(net), _leaves(loaded.net)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_missing_commit_marker_falls_back(self, tmp_path):
+        store, net, first, second = self._two_snapshots(tmp_path)
+        os.remove(os.path.join(second, "COMMIT"))
+        with pytest.raises(CheckpointInvalid, match="COMMIT"):
+            store.validate(second)
+        assert store.latest_valid() == first
+        assert store.load_latest().path == first
+
+    def test_corrupt_model_bytes_fall_back(self, tmp_path):
+        store, net, first, second = self._two_snapshots(tmp_path)
+        mp = os.path.join(second, "model.zip")
+        blob = bytearray(open(mp, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(mp, "wb").write(bytes(blob))
+        assert store.latest_valid() == first
+
+    def test_fault_during_write_leaves_previous_valid(self, tmp_path):
+        """Kill-during-checkpoint-write: the writer dies mid-artifact —
+        no torn snapshot is ever visible, restore serves the previous
+        valid state."""
+        store, net, first, second = self._two_snapshots(tmp_path)
+        it = harness.build_iterator()
+        it.reset()
+        net.fit(it, epochs=1)
+        plan = faults.FaultPlan().fail("checkpoint.write",
+                                       exc=IOError("disk gone"))
+        with plan.active():
+            with pytest.raises(IOError, match="disk gone"):
+                store.save(TrainingState.capture(net, cursor=None))
+        assert plan.triggered == [("checkpoint.write", 1)]
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.startswith(".wipstate_")]
+        assert store.load_latest().path == second
+
+    def test_commit_gate_refusal_publishes_nothing(self, tmp_path):
+        store, net, first, second = self._two_snapshots(tmp_path)
+        before = store.snapshots()
+        it = harness.build_iterator()
+        it.reset()
+        net.fit(it, epochs=1)
+        out = store.save(TrainingState.capture(net, cursor=None),
+                         commit_gate=lambda digest: False)
+        assert out is None
+        assert store.snapshots() == before
+
+    def test_agree_on_digest_detects_divergence(self):
+        from deeplearning4j_tpu.parallel.distributed import agree_on_digest
+        d = "ab" * 32
+        same = lambda local: np.stack([local, local])
+        assert agree_on_digest(d, allgather=same)
+        other = np.frombuffer(bytes.fromhex("cd" * 32), dtype=np.uint8)
+        diverged = lambda local: np.stack([local, other])
+        assert not agree_on_digest(d, allgather=diverged)
+
+
+# ----------------------------------------------------------------------
+# async writer
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestAsyncCheckpointWriter:
+    def test_single_outstanding(self, tmp_path):
+        import threading
+
+        gate = threading.Event()
+
+        class SlowStore(CheckpointStore):
+            def save(self, state, **kw):
+                gate.wait(10.0)
+                return super().save(state, **kw)
+
+        from deeplearning4j_tpu.util.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        net = harness.build_net()
+        net.fit(harness.build_iterator(), epochs=1)
+        w = AsyncCheckpointWriter(SlowStore(str(tmp_path)), registry=reg)
+        try:
+            assert w.submit(TrainingState.capture(net))
+            assert not w.submit(TrainingState.capture(net))   # busy
+            skipped = reg.get("checkpoint_writes_skipped_total")
+            assert skipped.snapshot()["series"][0]["value"] == 1
+            gate.set()
+            assert w.drain(timeout=10.0)
+            it = harness.build_iterator()
+            net.fit(it, epochs=2)          # advance → a distinct snapshot
+            assert w.submit(TrainingState.capture(net))       # idle again
+            assert w.drain(timeout=10.0)
+        finally:
+            gate.set()
+            w.close()
+        commits = reg.get("checkpoint_commits_total").snapshot()["series"]
+        assert sum(s["value"] for s in commits) == 2
+        hist = reg.get("checkpoint_write_seconds").snapshot()["series"][0]
+        assert hist["count"] == 2
+
+    def test_collective_mode_waits_instead_of_skipping(self, tmp_path):
+        """With a collective commit gate (multi-process) the busy-skip
+        must not be a host-local decision — submit waits for the
+        outstanding write so every host attempts every checkpoint and
+        the allgather inside the gate never deadlocks."""
+        import threading
+
+        gate = threading.Event()
+        first_started = threading.Event()
+
+        class SlowStore(CheckpointStore):
+            def save(self, state, **kw):
+                first_started.set()
+                gate.wait(10.0)
+                return super().save(state, **kw)
+
+        from deeplearning4j_tpu.util.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        net = harness.build_net()
+        net.fit(harness.build_iterator(), epochs=1)
+        w = AsyncCheckpointWriter(SlowStore(str(tmp_path), keep=8),
+                                  registry=reg, collective=True)
+        try:
+            assert w.submit(TrainingState.capture(net))
+            first_started.wait(10.0)
+            threading.Timer(0.2, gate.set).start()
+            net.fit(harness.build_iterator(), epochs=2)
+            # busy at call time — waits for the first write, then submits
+            assert w.submit(TrainingState.capture(net))
+            assert w.drain(timeout=10.0)
+        finally:
+            gate.set()
+            w.close()
+        assert reg.get("checkpoint_writes_skipped_total") is None
+        commits = reg.get("checkpoint_commits_total").snapshot()["series"]
+        assert sum(s["value"] for s in commits) == 2
+
+    def test_write_failure_is_contained(self, tmp_path):
+        from deeplearning4j_tpu.util.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        net = harness.build_net()
+        net.fit(harness.build_iterator(), epochs=1)
+        w = AsyncCheckpointWriter(CheckpointStore(str(tmp_path)),
+                                  registry=reg)
+        plan = faults.FaultPlan().fail("checkpoint.write",
+                                       exc=IOError("enospc"))
+        try:
+            with plan.active():
+                assert w.submit(TrainingState.capture(net))
+                assert w.drain(timeout=10.0)
+        finally:
+            w.close()
+        assert isinstance(w.last_error, IOError)
+        failures = reg.get("checkpoint_write_failures_total")
+        assert failures.snapshot()["series"][0]["value"] == 1
+
+
+# ----------------------------------------------------------------------
+# watchdog
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestStepWatchdog:
+    def test_expiry_dump_names_queues_breakers_and_span(self):
+        from deeplearning4j_tpu.util.resilience import (CircuitBreaker,
+                                                        ManualClock)
+        from deeplearning4j_tpu.util.tracing import Tracer
+
+        clock = ManualClock()
+        breaker = CircuitBreaker(name="wd-test-breaker",
+                                 failure_threshold=1)
+        breaker.record_failure()           # OPEN shows up in the dump
+        tracer = Tracer()
+        wd = StepWatchdog(5.0, clock=clock)
+        wd.arm()
+        with tracer.span("fit.step", attributes={"iteration": 3}):
+            wd.pet()                       # captures the active span
+        clock.advance(5.1)
+        with pytest.raises(WatchdogTimeout) as ei:
+            wd.check()
+        dump = ei.value.dump
+        assert dump["breakers"]["wd-test-breaker"] == "open"
+        assert dump["active_span"]["name"] == "fit.step"
+        assert "queue_depths" in dump
+        assert dump["deadline_s"] == 5.0
+        wd.disarm()
+
+    def test_progress_keeps_it_quiet(self):
+        from deeplearning4j_tpu.util.resilience import ManualClock
+        clock = ManualClock()
+        wd = StepWatchdog(5.0, clock=clock)
+        wd.arm()
+        for _ in range(10):
+            clock.advance(4.0)
+            wd.pet()                       # never 5s without progress
+        wd.check()
+        wd.disarm()
+
+    def test_threaded_expiry_unwinds_hung_dispatch_despite_handler(self):
+        """An expired watchdog must interrupt the main thread even when a
+        PreemptionHandler owns SIGINT — the simulated signal has to
+        unwind the hung call, not be absorbed as a graceful-drain
+        request a hung loop can never observe."""
+        import time
+        handler = PreemptionHandler().install()
+        wd = StepWatchdog(0.2, thread=True, poll_interval_s=0.02)
+        try:
+            wd.arm()
+            with pytest.raises(KeyboardInterrupt):
+                time.sleep(10)             # the "hung dispatch"
+            assert not handler.requested   # not mistaken for a drain
+            assert wd.last_dump is not None
+        finally:
+            wd.disarm()
+            handler.uninstall()
+
+    def test_rearm_after_expiry_restarts_monitor_thread(self):
+        """The monitor thread exits after one expiry; a re-arm for the
+        next phase must start a fresh one, not leave a dead watcher."""
+        import time
+        fired = []
+        wd = StepWatchdog(0.05, thread=True, poll_interval_s=0.01,
+                          on_timeout=fired.append)
+        wd.arm()
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fired
+        wd._thread.join(timeout=5.0)
+        wd.arm()
+        assert wd._thread.is_alive()
+        wd.disarm()
+
+    def test_earlystopping_trainer_pets_watchdog(self):
+        from deeplearning4j_tpu.earlystopping.config import \
+            EarlyStoppingConfiguration
+        from deeplearning4j_tpu.earlystopping.savers import InMemoryModelSaver
+        from deeplearning4j_tpu.earlystopping.scorecalc import \
+            DataSetLossCalculator
+        from deeplearning4j_tpu.earlystopping.termination import \
+            MaxEpochsTerminationCondition
+        from deeplearning4j_tpu.earlystopping.trainer import \
+            EarlyStoppingTrainer
+        from deeplearning4j_tpu.util.resilience import ManualClock
+
+        it = harness.build_iterator()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(1)],
+            score_calculator=DataSetLossCalculator(it),
+            model_saver=InMemoryModelSaver())
+        clock = ManualClock()
+        wd = StepWatchdog(5.0, clock=clock)
+        plan = faults.FaultPlan()          # count training.step hits
+        trainer = EarlyStoppingTrainer(cfg, harness.build_net(),
+                                       harness.build_iterator(),
+                                       watchdog=wd)
+        with plan.active():
+            trainer.fit()
+        assert plan.calls("training.step") == harness.N_BATCHES
+
+
+# ----------------------------------------------------------------------
+# kill-and-resume exactness (the acceptance pins)
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestKillResumeExactness:
+    EPOCHS = 2
+    TOTAL = 2 * harness.N_BATCHES
+
+    def _resume_and_finish(self, tmp_path, scores):
+        t2 = DurableTrainer(harness.build_net(), str(tmp_path),
+                            frequency=2, handle_signals=False,
+                            async_writes=False)
+        assert t2.resumed
+        resumed_from = t2.net.iteration_count
+        t2.net.add_listener(_scores_listener(scores))
+        t2.fit(harness.build_iterator(), epochs=self.EPOCHS)
+        return t2.net, resumed_from
+
+    def test_kill_at_mid_epoch_step_boundary(self, tmp_path):
+        """Crash (exception at the training.step seam) right at a step
+        boundary mid-epoch-2; resume replays zero batches and the
+        trajectory + final params are bit-identical."""
+        ref_net, ref_scores = _reference_run(self.EPOCHS)
+        scores = []
+        t1 = DurableTrainer(harness.build_net(), str(tmp_path),
+                            frequency=2, handle_signals=False,
+                            async_writes=False)
+        t1.net.add_listener(_scores_listener(scores))
+        plan = faults.FaultPlan()
+
+        def die(payload):
+            if payload["iteration"] == 9:    # after 9 applied steps
+                raise faults.InjectedFault("preempted at step boundary")
+        plan.always("training.step", exc=die)
+        with plan.active():
+            with pytest.raises(faults.InjectedFault):
+                t1.fit(harness.build_iterator(), epochs=self.EPOCHS)
+        assert ("training.step", 10) in plan.triggered
+        assert len(scores) == 9
+
+        net, resumed_from = self._resume_and_finish(tmp_path, scores)
+        assert resumed_from == 8           # frequency=2 snapshot at iter 8
+        assert net.iteration_count == self.TOTAL
+        # killed run saw 1..9, resume re-dispatches 9..24 from iter 8 —
+        # the overlap is re-scored identically, nothing is double-applied
+        assert scores[:9] == ref_scores[:9]
+        assert scores[9 + (9 - resumed_from):] == ref_scores[9:]
+        for a, b in zip(_leaves(ref_net), _leaves(net)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_kill_during_checkpoint_write_falls_back_exactly(self,
+                                                             tmp_path):
+        """The process dies WHILE writing the iter-8 snapshot (torn
+        bytes on disk, then the exception kills fit): the torn state is
+        never restorable, resume falls back to the previous valid
+        snapshot (the epoch boundary at iter 6) and is still exact."""
+        ref_net, ref_scores = _reference_run(self.EPOCHS)
+        scores = []
+        t1 = DurableTrainer(harness.build_net(), str(tmp_path),
+                            frequency=2, handle_signals=False,
+                            async_writes=False)
+        t1.net.add_listener(_scores_listener(scores))
+
+        def tear(payload):
+            with open(payload["path"], "wb") as f:
+                f.write(payload["data"][:max(1, len(payload["data"]) // 3)])
+            raise IOError("writer killed mid-stream")
+        # sync snapshots before the kill: iter2, iter4, iter6(periodic),
+        # epoch-boundary, iter8 — 3 checkpoint.write calls each
+        # (model.zip, cursor.json, COMMIT); tear call 13 = iter-8 model.zip
+        plan = faults.FaultPlan().fail("checkpoint.write", after=12,
+                                       times=1, exc=tear)
+        with plan.active():
+            with pytest.raises(IOError, match="mid-stream"):
+                t1.fit(harness.build_iterator(), epochs=self.EPOCHS)
+        assert plan.triggered == [("checkpoint.write", 13)]
+        assert len(scores) == 8            # died during the iter-8 write
+
+        net, resumed_from = self._resume_and_finish(tmp_path, scores)
+        assert resumed_from == 6           # iter-8 snapshot torn → iter 6
+        assert net.iteration_count == self.TOTAL
+        assert scores[:8] == ref_scores[:8]
+        assert scores[8 + (8 - resumed_from):] == ref_scores[8:]
+        for a, b in zip(_leaves(ref_net), _leaves(net)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_programmatic_preemption_drains_and_resumes_exactly(
+            self, tmp_path):
+        """SIGTERM semantics in-process: preemption requested with
+        dispatches in flight → the window drains, a final cursor-bearing
+        snapshot commits, resume is exact from the very next batch."""
+        ref_net, ref_scores = _reference_run(self.EPOCHS)
+        scores = []
+        t1 = DurableTrainer(harness.build_net(), str(tmp_path),
+                            frequency=100, handle_signals=True)
+        t1.net.add_listener(_scores_listener(scores))
+        plan = faults.FaultPlan()
+
+        def preempt(payload):
+            if payload["iteration"] == 8:   # mid-epoch 2
+                t1.session.preemption.request()
+        plan.always("training.step", exc=preempt)
+        with plan.active():
+            t1.fit(harness.build_iterator(), epochs=self.EPOCHS)
+        assert t1.preempted
+        assert t1.net.iteration_count == 9   # step 9 dispatched, drained
+
+        t2 = DurableTrainer(harness.build_net(), str(tmp_path),
+                            frequency=100, handle_signals=False)
+        assert t2.resumed and t2.net.iteration_count == 9
+        t2.net.add_listener(_scores_listener(scores))
+        t2.fit(harness.build_iterator(), epochs=self.EPOCHS)
+        assert not t2.preempted
+        assert t2.net.iteration_count == self.TOTAL
+        assert scores == ref_scores
+        for a, b in zip(_leaves(ref_net), _leaves(t2.net)):
+            np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------------------------------
+# subprocess kill harness (fresh-process resume)
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestSubprocessKillResume:
+    def test_hard_kill_then_fresh_process_resume_matches(self, tmp_path):
+        """Child 1 is os._exit-killed at iteration 5 (no drain, no final
+        write); child 2 resumes from the newest committed snapshot and
+        finishes. Final params match an uninterrupted in-process run
+        bit-for-bit."""
+        d = str(tmp_path)
+        cfg = {"checkpoint_dir": d, "total_epochs": 2, "frequency": 2,
+               "kill_mode": "exit", "kill_at_iteration": 5,
+               "async": False}     # sync snapshots: deterministic kill point
+        rc, err = harness.run_child(cfg)
+        assert rc == 9, err
+        assert not os.path.exists(os.path.join(d, "result.json"))
+        snaps = [n for n in os.listdir(d) if n.startswith("state_")]
+        assert snaps, "no committed snapshot survived the hard kill"
+
+        rc, err = harness.run_child({"checkpoint_dir": d,
+                                     "total_epochs": 2, "frequency": 2})
+        assert rc == 0, err
+        result = json.load(open(os.path.join(d, "result.json")))
+        assert result["resumed"] and not result["preempted"]
+        assert result["iteration_count"] == 2 * harness.N_BATCHES
+
+        ref_net, ref_scores = _reference_run(2)
+        assert result["params_sha"] == harness.params_sha(ref_net)
+        # the resumed child's trajectory is the uninterrupted tail
+        k = len(result["scores"])
+        assert result["scores"] == ref_scores[len(ref_scores) - k:]
+
+    def test_sigterm_with_inflight_drains_then_resumes(self, tmp_path):
+        """Child self-SIGTERMs mid-epoch with dispatches in flight: the
+        preemption handler drains, writes a final snapshot and exits 0;
+        an in-process resume completes bit-identically."""
+        d = str(tmp_path)
+        cfg = {"checkpoint_dir": d, "total_epochs": 2, "frequency": 100,
+               "kill_mode": "sigterm", "kill_at_iteration": 8}
+        rc, err = harness.run_child(cfg)
+        assert rc == 0, err
+        result = json.load(open(os.path.join(d, "result.json")))
+        assert result["preempted"]
+        assert result["iteration_count"] == 9
+        os.remove(os.path.join(d, "result.json"))
+
+        t2 = DurableTrainer(harness.build_net(), d, frequency=100,
+                            handle_signals=False)
+        assert t2.resumed and t2.net.iteration_count == 9
+        scores = list(result["scores"])
+        t2.net.add_listener(_scores_listener(scores))
+        t2.fit(harness.build_iterator(), epochs=2)
+        ref_net, ref_scores = _reference_run(2)
+        assert scores == ref_scores
+        for a, b in zip(_leaves(ref_net), _leaves(t2.net)):
+            np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------------------------------
+# preemption handler mechanics
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestPreemptionHandler:
+    def test_signal_sets_flag_second_signal_raises(self):
+        import signal as _signal
+        h = PreemptionHandler(signals=(_signal.SIGUSR1,))
+        with h:
+            assert not h.requested
+            os.kill(os.getpid(), _signal.SIGUSR1)
+            # the C-level handler flags immediately; CPython runs the
+            # Python handler at a bytecode boundary — spin briefly
+            import time
+            deadline = time.monotonic() + 2.0
+            while not h.requested and time.monotonic() < deadline:
+                pass
+            assert h.requested
+            with pytest.raises(KeyboardInterrupt):
+                h._handle(_signal.SIGUSR1, None)
+        assert not h.installed
+
+    def test_session_max_steps_stops_cleanly(self, tmp_path):
+        net = harness.build_net()
+        store = CheckpointStore(str(tmp_path))
+        it = harness.build_iterator()
+        session = DurableSession(net, store, data=it, max_steps=4)
+        net.fit(it, epochs=2, session=session)
+        assert session.stopped and session.stop_reason == "max_steps"
+        assert net.iteration_count == 4
+        assert net.epoch_count == 0        # partial epoch never counted
+
+    def test_mid_epoch_preempt_non_seekable_keeps_boundary_snapshot(
+            self, tmp_path):
+        """Over a NON-seekable source a mid-epoch final snapshot would
+        be newer than the boundary one yet impossible to resume exactly
+        (the restarted epoch re-applies its first batches). final_snapshot
+        must refuse it and leave the boundary snapshot as the recovery
+        point."""
+        store = CheckpointStore(str(tmp_path), keep=8)
+        net = harness.build_net()
+        data = ExistingDataSetIterator(
+            [DataSet(np.ones((2, 5), np.float32),
+                     np.ones((2, 3), np.float32))])
+        session = DurableSession(net, store, data=data, frequency=1)
+        assert not session.seekable
+        session.on_epoch_boundary(net)
+        assert len(store.snapshots()) == 1
+        net.iteration_count += 1
+        session.on_step(net)               # now mid-epoch
+        assert session.final_snapshot(net) is None
+        assert len(store.snapshots()) == 1   # boundary snapshot remains
+
+    def test_coalesced_stride_checkpoints_every_frequency_window(
+            self, tmp_path):
+        """fit_scan coalescing advances iteration_count by k per
+        dispatched step; a divisibility trigger (it % frequency == 0)
+        only fires at multiples of lcm(k, frequency). The crossing
+        trigger fires once per frequency window regardless of stride."""
+        store = CheckpointStore(str(tmp_path), keep=8)
+        net = harness.build_net()
+        session = DurableSession(net, store, data=harness.build_iterator(),
+                                 frequency=4)
+        for _ in range(5):                 # k=3: counter 3, 6, 9, 12, 15
+            net.iteration_count += 3
+            session.on_step(net, n_consumed=3)
+        # windows crossed at 6, 9 and 12 — divisibility would only have
+        # fired at 12
+        assert len(store.snapshots()) == 3
+
+
+@pytest.mark.chaos
+class TestComputationGraphDurability:
+    def test_graph_preempt_and_exact_resume(self, tmp_path):
+        """TrainingState round-trips the ComputationGraph runtime too
+        (model_class dispatch through load_model)."""
+        from deeplearning4j_tpu.nn.conf.builders import \
+            NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+        def gnet():
+            b = (NeuralNetConfiguration.builder().seed(3).updater("adam")
+                 .learning_rate(0.01).graph_builder()
+                 .add_inputs("in")
+                 .add_layer("d", DenseLayer(n_in=5, n_out=8,
+                                            activation="tanh"), "in")
+                 .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                               activation="softmax",
+                                               loss="mcxent"), "d")
+                 .set_outputs("out"))
+            return ComputationGraph(b.build()).init()
+
+        straight = gnet()
+        straight.fit(harness.build_iterator(), epochs=1)
+
+        t1 = DurableTrainer(gnet(), str(tmp_path), frequency=2,
+                            handle_signals=True, async_writes=False)
+        plan = faults.FaultPlan()
+
+        def preempt(payload):
+            if payload["iteration"] == 3:
+                t1.session.preemption.request()
+        plan.always("training.step", exc=preempt)
+        with plan.active():
+            t1.fit(harness.build_iterator(), epochs=1)
+        assert t1.preempted and t1.net.iteration_count == 4
+
+        t2 = DurableTrainer(gnet(), str(tmp_path), frequency=2,
+                            handle_signals=False, async_writes=False)
+        assert t2.resumed
+        assert type(t2.net).__name__ == "ComputationGraph"
+        t2.fit(harness.build_iterator(), epochs=1)
+        assert t2.net.iteration_count == harness.N_BATCHES
+        for a, b in zip(_leaves(straight), _leaves(t2.net)):
+            np.testing.assert_array_equal(a, b)

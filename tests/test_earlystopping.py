@@ -115,3 +115,82 @@ class TestEarlyStopping:
                .build())
         result = EarlyStoppingTrainer(cfg, _net(), train).fit()
         assert 0.0 <= result.best_model_score <= 1.0
+
+
+@pytest.mark.chaos
+class TestLocalFileSaverDurability:
+    """ISSUE 5 satellite: a crash or torn write mid-``save_best_model``
+    must never cost the best model. Saves stage + validate before they
+    publish; the outgoing model rotates to ``*.prev.zip``; reads fall
+    back past an invalid file like ``CheckpointRecovery.latest_valid``."""
+
+    def _saver_with_two_bests(self, rng, tmp_path):
+        from deeplearning4j_tpu.earlystopping.savers import \
+            LocalFileModelSaver
+        saver = LocalFileModelSaver(str(tmp_path))
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net = _net()
+        net.fit(x, y, epochs=1)
+        saver.save_best_model(net, 1.0)
+        net.fit(x, y, epochs=1)
+        saver.save_best_model(net, 0.5)     # rotates the first to .prev
+        return saver, net
+
+    def test_rotation_keeps_previous_as_fallback(self, rng, tmp_path):
+        saver, net = self._saver_with_two_bests(rng, tmp_path)
+        assert (tmp_path / "bestModel.zip").exists()
+        assert (tmp_path / "bestModel.prev.zip").exists()
+        assert saver.get_best_model().iteration_count == net.iteration_count
+
+    def test_torn_write_never_publishes(self, rng, tmp_path):
+        """A writer dying mid-stream (scripted at the checkpoint.write
+        seam) leaves the PUBLISHED best model untouched and loadable."""
+        from deeplearning4j_tpu.util import faults
+        saver, net = self._saver_with_two_bests(rng, tmp_path)
+        good_iter = net.iteration_count
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y, epochs=1)
+
+        def tear(payload):
+            with open(payload["path"], "wb") as f:
+                f.write(payload["data"][:len(payload["data"]) // 2])
+            raise IOError("writer killed mid-stream")
+
+        plan = faults.FaultPlan().fail("checkpoint.write", exc=tear)
+        with plan.active():
+            with pytest.raises(IOError, match="mid-stream"):
+                saver.save_best_model(net, 0.25)
+        assert saver.get_best_model().iteration_count == good_iter
+
+    def test_corrupt_published_best_falls_back_to_prev(self, rng,
+                                                       tmp_path):
+        saver, net = self._saver_with_two_bests(rng, tmp_path)
+        best = tmp_path / "bestModel.zip"
+        blob = bytearray(best.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        best.write_bytes(bytes(blob))
+        restored = saver.get_best_model()       # falls back to .prev
+        assert restored is not None
+        assert restored.iteration_count < net.iteration_count
+
+    def test_all_invalid_returns_none(self, rng, tmp_path):
+        saver, net = self._saver_with_two_bests(rng, tmp_path)
+        for name in ("bestModel.zip", "bestModel.prev.zip"):
+            (tmp_path / name).write_bytes(b"")
+        assert saver.get_best_model() is None
+
+    def test_corrupt_current_never_clobbers_good_prev(self, rng,
+                                                      tmp_path):
+        """Rotation is gated on the outgoing file still validating: a
+        corrupt current best must not overwrite a good .prev fallback."""
+        saver, net = self._saver_with_two_bests(rng, tmp_path)
+        prev_bytes = (tmp_path / "bestModel.prev.zip").read_bytes()
+        (tmp_path / "bestModel.zip").write_bytes(b"garbage")
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y, epochs=1)
+        saver.save_best_model(net, 0.1)
+        assert (tmp_path / "bestModel.prev.zip").read_bytes() == prev_bytes
+        assert saver.get_best_model().iteration_count == net.iteration_count

@@ -144,3 +144,78 @@ class TestFlashAttention:
         for a, b in zip(g_ref, g_fl):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestTraceTimeFlagRouting:
+    """VERDICT r5 item 9: ``DL4JTPU_FLASH_ATTENTION`` / ``DL4JTPU_FLASH_BWD``
+    are read at TRACE time, so historically a toggle only took effect
+    after manually clearing jit caches. The runtimes now key their jit
+    caches on ``util.xla.trace_env_key()``: flipping a flag makes the
+    next call trace a FRESH program under the new routing, and flipping
+    it back reuses the original compilation."""
+
+    def _net(self):
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+                .learning_rate(0.1).list()
+                .layer(DenseLayer(n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_toggle_takes_effect_without_manual_cache_clearing(
+            self, rng, monkeypatch):
+        monkeypatch.delenv("DL4JTPU_FLASH_ATTENTION", raising=False)
+        monkeypatch.delenv("DL4JTPU_FLASH_BWD", raising=False)
+        net = self._net()
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        net.fit_batch(x, y)
+        keys0 = set(net._jit_cache)
+        net.fit_batch(x, y)
+        assert set(net._jit_cache) == keys0      # steady state: one program
+
+        monkeypatch.setenv("DL4JTPU_FLASH_BWD", "jax")
+        net.fit_batch(x, y)
+        new = set(net._jit_cache) - keys0        # fresh trace, new routing
+        assert len(new) == 1 and "fabwd=jax" in new.pop()
+
+        monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "0")
+        net.output(x)
+        assert any("fa=0" in k and k.startswith("output") for k in
+                   net._jit_cache)
+
+        # flipping BACK reuses the original compiled entry — no growth
+        monkeypatch.delenv("DL4JTPU_FLASH_BWD")
+        monkeypatch.delenv("DL4JTPU_FLASH_ATTENTION")
+        n = len(net._jit_cache)
+        net.fit_batch(x, y)
+        assert len(net._jit_cache) == n
+
+    def test_graph_runtime_keys_cache_on_flags(self, rng, monkeypatch):
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        monkeypatch.delenv("DL4JTPU_FLASH_BWD", raising=False)
+        b = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+             .learning_rate(0.1).graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_in=3, n_out=4,
+                                        activation="tanh"), "in")
+             .add_layer("out", OutputLayer(n_in=4, n_out=2,
+                                           activation="softmax",
+                                           loss="mcxent"), "d")
+             .set_outputs("out"))
+        net = ComputationGraph(b.build()).init()
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        net.fit_batch(x, y)
+        keys0 = set(net._jit_cache)
+        monkeypatch.setenv("DL4JTPU_FLASH_BWD", "jax")
+        net.fit_batch(x, y)
+        new = set(net._jit_cache) - keys0
+        assert len(new) == 1 and "fabwd=jax" in new.pop()

@@ -250,6 +250,34 @@ class TestRecoverableTrainer:
         # boundary (iteration 1), not the newer periodic save (iteration 2)
         assert t2.net.iteration_count == 1
 
+    def test_unloadable_newer_boundary_falls_back_to_durable(
+            self, tmp_path, rng):
+        """A boundary zip that validates but fails to LOAD must fall
+        back to the next-newest recovery point ACROSS kinds — here an
+        older durable snapshot — not silently past it to an even older
+        zip (or to nothing)."""
+        from deeplearning4j_tpu.util import faults
+        from deeplearning4j_tpu.util.durable import (CheckpointStore,
+                                                     TrainingState)
+
+        net = _net()
+        x, y = _data(rng)
+        net.fit(x, y, epochs=1)
+        CheckpointStore(str(tmp_path)).save(TrainingState.capture(net))
+        net.fit(x, y, epochs=1)
+        CheckpointRecovery(str(tmp_path)).save(net)   # newer legacy zip
+
+        def boom(payload):
+            if payload["path"].endswith(".zip"):
+                raise IOError("validates but will not load")
+
+        plan = faults.FaultPlan()
+        plan.always("recovery.restore", exc=boom)
+        with plan.active():
+            t = RecoverableTrainer(_net(), str(tmp_path))
+        assert t.resumed
+        assert t.net.epoch_count == 1     # the durable snapshot won
+
     def test_listener_removed_after_fit(self, tmp_path, rng):
         x, y = _data(rng)
         t = RecoverableTrainer(_net(), str(tmp_path))
@@ -280,3 +308,54 @@ class TestRecoverableTrainer:
         assert t2.resumed and t2.net.epoch_count == 2
         with pytest.raises(ValueError, match="mask"):
             t2.fit(x, y, epochs=3, mask=np.ones((64, 1), np.float32))
+
+
+@pytest.mark.chaos
+class TestRecoverableTrainerExactResume:
+    """ISSUE 5: with a seekable source, RecoverableTrainer's mid-epoch
+    recovery points are cursor-bearing TrainingState snapshots — resume
+    replays zero batches and matches the uninterrupted run bit-for-bit
+    (the old periodic_* "manual recovery re-runs the partial epoch"
+    caveat is gone)."""
+
+    def _batches(self):
+        # a FRESH seeded stream per call: every run (reference, killed,
+        # resumed) must see the identical dataset
+        from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+        x, y = _data(np.random.default_rng(99))
+        return ListDataSetIterator(
+            [DataSet(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8])
+             for i in range(8)], batch_size=8)
+
+    def test_mid_epoch_crash_resumes_bit_exactly(self, tmp_path):
+        from deeplearning4j_tpu.util import faults
+
+        straight = _net()
+        straight.fit(self._batches(), epochs=2)
+
+        t1 = RecoverableTrainer(_net(), str(tmp_path), frequency=2)
+        plan = faults.FaultPlan()
+
+        def die(payload):
+            if payload["iteration"] == 11:   # mid-epoch 2 (8 per epoch)
+                raise faults.InjectedFault("killed mid-epoch")
+        plan.always("training.step", exc=die)
+        with plan.active():
+            with pytest.raises(faults.InjectedFault):
+                t1.fit(self._batches(), epochs=2)
+
+        t2 = RecoverableTrainer(_net(), str(tmp_path), frequency=2)
+        assert t2.resumed
+        # the resume point depends on which ASYNC snapshot committed
+        # before the kill (epoch boundary at 8, or the mid-epoch cursor
+        # snapshot at 10 — a busy writer may have skipped it); the
+        # exactness contract holds from either, and the deterministic
+        # mid-epoch case is pinned by test_durable.py with sync writes
+        assert t2.net.iteration_count >= 8
+        t2.fit(self._batches(), epochs=2)
+        assert t2.net.iteration_count == 16
+        assert t2.net.epoch_count == 2
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(straight.params),
+                        jax.tree_util.tree_leaves(t2.net.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
