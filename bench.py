@@ -553,31 +553,82 @@ def bench_flash_attention() -> dict:
             "seq_len": t, "dtype": "bfloat16"}
 
 
+def _transformer_train_flops_per_token(d_model, n_layers, d_ff, vocab,
+                                       t) -> float:
+    """Analytic train FLOPs per token for the decoder-only LM, stated
+    once (the MFU numerator's single source of truth, PERF.md r8):
+
+        3 × [ 2·(L·(4·d² + 2·d·d_ff) + d·V)  +  L·2·(T/2)·d·2 ]
+
+    i.e. train ≈ 3× forward; forward = 2 FLOPs per matmul-parameter MAC
+    (Wqkv 3d² + Wo d² + FFN 2·d·d_ff per layer, plus the d·V vocab head —
+    the embedding GATHER does no FLOPs, which is the point of the
+    integer-id input path), plus the causal attention matmuls (QKᵀ and
+    PV: 2 matmuls × 2 FLOPs × T/2 average attended keys × d per layer).
+    LayerNorm/softmax/residual vector work is excluded, same convention
+    as the ResNet formula above."""
+    matmul_params = (n_layers * (4.0 * d_model * d_model
+                                 + 2.0 * d_model * d_ff)
+                     + d_model * vocab)
+    attn = n_layers * 2.0 * (t / 2.0) * d_model * 2.0
+    return 3.0 * (2.0 * matmul_params + attn)
+
+
 def bench_transformer_lm() -> dict:
-    """Long-context transformer LM (DSL model, flash auto-routed at
-    T=4096) via fit_repeated — k on-chip steps per dispatch, so the
-    number is the true training step, not the dev tunnel's per-dispatch
-    latency (PERF.md r5 methodology note)."""
+    """Transformer-LM flagship (ROADMAP item 1): GPT-2-class config —
+    d_model 768, 12 layers, 12 heads, T=2048, V=32768 — trained through
+    the PUBLIC fit_repeated path on integer token ids (the one-hot
+    [b, T, V] construction dies at V≫8; ids are 4 bytes/token), with the
+    Pallas flash attention kernel forced on (fwd+bwd; T=2048 sits below
+    the auto-route threshold but well inside the kernel's measured-win
+    band). Reports MFU from the analytic FLOPs formula above — the
+    metric the >40% north star is stated in, reachable here because
+    transformer GEMMs (K≈768–3072) sit in this chip's 55–67 TF shape
+    band (PERF.md r4 probes), unlike ResNet's conv mix."""
     from deeplearning4j_tpu.models import transformer_lm
     from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
 
-    V, T, b, k = 8, 4096, 4, 16
-    net = ComputationGraph(transformer_lm(
-        V, n_layers=2, d_model=256, n_heads=4, d_ff=1024,
-        learning_rate=3e-4)).init()
-    ids = np.array([[(i + j) % V for i in range(T + 1)] for j in range(b)])
-    eye = np.eye(V, dtype=np.float32)
-    x, y = eye[ids[:, :-1]], eye[ids[:, 1:]]
-    np.asarray(net.fit_repeated([x], [y], k))  # warmup/compile
-    rounds = 3
-    t0 = time.perf_counter()
-    for _ in range(rounds):
-        losses = net.fit_repeated([x], [y], k)
-    np.asarray(losses)
-    step_s = (time.perf_counter() - t0) / (rounds * k)
+    V = int(os.environ.get("BENCH_TLM_VOCAB", "32768"))
+    T = int(os.environ.get("BENCH_TLM_T", "2048"))
+    b = int(os.environ.get("BENCH_TLM_BATCH", "8"))
+    d_model = int(os.environ.get("BENCH_TLM_DMODEL", "768"))
+    n_layers = int(os.environ.get("BENCH_TLM_LAYERS", "12"))
+    n_heads = d_model // 64
+    d_ff = 4 * d_model
+    k, rounds = int(os.environ.get("BENCH_TLM_SCAN", "8")), 2
+
+    prior = os.environ.get("DL4JTPU_FLASH_ATTENTION")
+    os.environ["DL4JTPU_FLASH_ATTENTION"] = "1"
+    try:
+        net = ComputationGraph(transformer_lm(
+            V, n_layers=n_layers, d_model=d_model, n_heads=n_heads,
+            d_ff=d_ff, learning_rate=3e-4, dtype="mixed_bf16",
+            input_ids=True)).init()
+        rng = np.random.default_rng(19)
+        ids = rng.integers(0, V, (b, T + 1)).astype(np.int32)
+        x, y = ids[:, :-1], ids[:, 1:]
+        np.asarray(net.fit_repeated([x], [y], k))  # warmup/compile
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            losses = net.fit_repeated([x], [y], k)
+        np.asarray(losses)
+        step_s = (time.perf_counter() - t0) / (rounds * k)
+    finally:
+        if prior is None:
+            os.environ.pop("DL4JTPU_FLASH_ATTENTION", None)
+        else:
+            os.environ["DL4JTPU_FLASH_ATTENTION"] = prior
+    tokens_per_sec = b * T / step_s
+    fpt = _transformer_train_flops_per_token(d_model, n_layers, d_ff, V, T)
+    mfu = tokens_per_sec * fpt / _peak_flops_per_sec()
     return {"step_ms": round(step_s * 1e3, 2),
-            "tokens_per_sec": round(b * T / step_s, 1),
-            "batch": b, "seq_len": T, "d_model": 256, "n_layers": 2}
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4),
+            "model_flops_per_token": round(fpt, 1),
+            "batch": b, "seq_len": T, "d_model": d_model,
+            "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
+            "vocab": V, "input_mode": "ids", "dtype": "mixed_bf16",
+            "attention": "pallas_flash"}
 
 
 def main() -> None:
@@ -596,7 +647,7 @@ def main() -> None:
     _run_config(out, "lstm", bench_lstm)
     _run_config(out, "word2vec", bench_word2vec)
     _run_config(out, "flash_attention", bench_flash_attention)
-    _run_config(out, "transformer_lm", bench_transformer_lm)
+    tlm_res = _run_config(out, "transformer_lm", bench_transformer_lm)
 
     # snapshot the process-default metrics registry into the payload so
     # the perf trajectory carries whatever the run recorded (retry
@@ -609,6 +660,19 @@ def main() -> None:
             out["metrics"] = snap
     except Exception:
         pass    # metrics must never erase a round's evidence
+
+    # transformer flagship row: a SECOND named metric alongside the
+    # ResNet headline (which keeps the vs_baseline trajectory unbroken);
+    # same denominator convention — measured MFU ÷ the 40% north star
+    if tlm_res is not None and "mfu" in tlm_res:
+        out["transformer_lm_mfu"] = {
+            "metric": "transformer_lm_mfu",
+            "value": tlm_res["mfu"],
+            "unit": "mfu",
+            "vs_baseline": round(tlm_res["mfu"] / 0.40, 4),
+            "tokens_per_sec": tlm_res["tokens_per_sec"],
+            "model_flops_per_token": tlm_res["model_flops_per_token"],
+        }
 
     if resnet_res is not None:
         out.update({

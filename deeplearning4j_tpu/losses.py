@@ -99,6 +99,31 @@ def mcxent(labels, pre, activation):
     return -labels * jnp.log(p)
 
 
+@register("sparse_mcxent", "sparse_categorical_crossentropy")
+def sparse_mcxent(labels, pre, activation):
+    """Integer-class cross-entropy: ``labels`` holds CLASS IDS (shape =
+    pre.shape minus the class axis, e.g. [b, t] ids against [b, t, V]
+    logits) — the realistic-vocab path for LM training, where a one-hot
+    [b, t, V] label tensor at V ≫ 1k would dominate host/device memory.
+    Same per-row value as ``mcxent`` on the equivalent one-hot labels.
+    Requires the fused softmax head (no dense-probability fallback: a
+    clipped-log path would silently lose the log-space stability that is
+    the point of this loss).
+
+    Out-of-range ids (e.g. a tokenizer emitting V against a V-sized
+    head) yield NaN loss entries instead of XLA's silent gather clamp to
+    class V−1 — an off-by-one vocab bug must fail LOUDLY (non-finite
+    loss, caught by skip budgets/watchdogs), not train quietly against
+    the wrong class."""
+    if activation.lower() != "softmax":
+        raise ValueError("sparse_mcxent requires activation='softmax' "
+                         f"(got {activation!r})")
+    logp = jax.nn.log_softmax(pre, axis=-1)
+    ids = labels.astype(jnp.int32)
+    return -jnp.take_along_axis(logp, ids[..., None], axis=-1,
+                                mode="fill", fill_value=jnp.nan)[..., 0]
+
+
 @register("hinge")
 def hinge(labels, pre, activation):
     # labels in {-1, +1}
@@ -167,8 +192,15 @@ def score_array(loss_name: str, labels, pre_output, activation: str,
     return jnp.sum(per_elem, axis=axes) if axes else per_elem
 
 
+def is_sparse(loss_name: str) -> bool:
+    """True for losses whose labels are CLASS IDS (no class axis) rather
+    than per-output arrays — changes the mask-ndim contract below."""
+    return loss_name.lower() in ("sparse_mcxent",
+                                 "sparse_categorical_crossentropy")
+
+
 def masked_denominator(mask: Optional[jax.Array], labels,
-                       batch_size: int) -> jax.Array:
+                       batch_size: int, *, sparse: bool = False) -> jax.Array:
     """The averaging denominator under the explicit mask-kind contract
     (single source of truth — used by both :func:`score` and the network
     runtime's loss):
@@ -178,11 +210,15 @@ def masked_denominator(mask: Optional[jax.Array], labels,
       - mask.ndim == labels.ndim — a per-output mask; a row counts as active
         if ANY of its outputs is unmasked, so the denominator is
         ``sum(any(mask, axis=-1))``.
-    """
+    ``sparse=True`` (id-labeled losses — :func:`is_sparse`) declares that
+    labels carry NO class axis, so an equal-ndim mask is per-row there,
+    exactly like its dense one-hot equivalent — declared by the caller
+    from the loss identity, never sniffed from the label dtype (a dense
+    loss fed integer-typed labels must keep the per-output contract)."""
     if mask is None:
         return jnp.float32(batch_size)
-    if mask.ndim == labels.ndim:               # per-output mask
-        row_active = jnp.max(mask, axis=-1)
+    if mask.ndim == labels.ndim and not sparse:
+        row_active = jnp.max(mask, axis=-1)    # per-output mask
         return jnp.maximum(jnp.sum(row_active), 1.0)
     return jnp.maximum(jnp.sum(mask), 1.0)     # per-row (example/timestep)
 
@@ -197,4 +233,5 @@ def score(loss_name: str, labels, pre_output, activation: str,
     total = jnp.sum(arr)
     if not average:
         return total
-    return total / masked_denominator(mask, labels, labels.shape[0])
+    return total / masked_denominator(mask, labels, labels.shape[0],
+                                      sparse=is_sparse(loss_name))

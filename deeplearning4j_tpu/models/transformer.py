@@ -14,8 +14,14 @@ flatten/rebuild reshapes), so under a sequence-sharded mesh
 trivially over the time axis and attention rides the ring — no reshape of
 a sharded dim, no gather.
 
-Inputs are one-hot [b, t, vocab]; ``RnnOutputLayer`` gives per-step
-softmax + mcxent, so training/eval/serde all ride the standard paths.
+Two input contracts:
+  - default: one-hot [b, t, vocab] inputs + one-hot labels (``mcxent``) —
+    fine for toy vocabularies and the existing parallel-trainer tests;
+  - ``input_ids=True``: integer token ids [b, t] through an
+    ``EmbeddingSequenceLayer`` gather, integer labels through
+    ``sparse_mcxent`` — the REALISTIC-vocab path (a one-hot [b, t, V]
+    host tensor at V ≫ 8 cannot survive; ids are 4 bytes/token however
+    large V grows). Same math: one-hot @ W ≡ W[ids].
 """
 
 from __future__ import annotations
@@ -24,7 +30,8 @@ from ..nn.conf.attention import SelfAttentionLayer
 from ..nn.conf.builders import NeuralNetConfiguration
 from ..nn.conf.graph import ElementWiseVertex
 from ..nn.conf.inputs import InputType
-from ..nn.conf.layers import LayerNormalization, RnnOutputLayer
+from ..nn.conf.layers import (EmbeddingSequenceLayer, LayerNormalization,
+                              RnnOutputLayer)
 from ..nn.conf.recurrent import TimeDistributedDenseLayer
 
 
@@ -32,7 +39,8 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
                    d_model: int = 256, n_heads: int = 4, d_ff: int = 1024,
                    updater: str = "adam", learning_rate: float = 3e-4,
                    seed: int = 42, dtype: str = "float32",
-                   moe_experts: int = 0, moe_top_k: int = 2):
+                   moe_experts: int = 0, moe_top_k: int = 2,
+                   input_ids: bool = False):
     """Causal LM: in-proj → n_layers × [ln → attention (+res) → ln → ffn
     (+res)] → final ln → vocab head.
 
@@ -40,7 +48,10 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
     routed ``MoELayer`` (d_hidden=d_ff per expert, load-balancing aux loss
     included in training) — the expert-parallel model family; shard the
     expert dim over an ``ep`` mesh axis via
-    ``parallel.expert.ExpertParallelGraphTrainer``."""
+    ``parallel.expert.ExpertParallelGraphTrainer``.
+
+    ``input_ids=True`` switches to the integer-id contract (see module
+    docstring): feed [b, t] int32 ids, label with [b, t] int32 ids."""
     if d_model % n_heads:
         raise ValueError(f"d_model={d_model} not divisible by "
                          f"n_heads={n_heads}")
@@ -50,9 +61,16 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
           .dtype(dtype)
           .graph_builder()
           .add_inputs("in"))
-    gb.add_layer("embed",
-                 TimeDistributedDenseLayer(n_in=vocab_size, n_out=d_model,
-                                           activation="identity"), "in")
+    if input_ids:
+        gb.add_layer("embed",
+                     EmbeddingSequenceLayer(n_in=vocab_size,
+                                            n_out=d_model,
+                                            activation="identity"), "in")
+    else:
+        gb.add_layer("embed",
+                     TimeDistributedDenseLayer(n_in=vocab_size,
+                                               n_out=d_model,
+                                               activation="identity"), "in")
     prev = "embed"
     for i in range(n_layers):
         b = f"blk{i}"
@@ -87,9 +105,9 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
                       f"{b}_res1", ff_out)
         prev = f"{b}_res2"
     gb.add_layer("final_ln", LayerNormalization(), prev)
-    gb.add_layer("out", RnnOutputLayer(n_in=d_model, n_out=vocab_size,
-                                       activation="softmax", loss="mcxent"),
-                 "final_ln")
+    gb.add_layer("out", RnnOutputLayer(
+        n_in=d_model, n_out=vocab_size, activation="softmax",
+        loss="sparse_mcxent" if input_ids else "mcxent"), "final_ln")
     gb.set_outputs("out")
-    gb.set_input_types(InputType.recurrent(vocab_size))
+    gb.set_input_types(InputType.recurrent(1 if input_ids else vocab_size))
     return gb.build()

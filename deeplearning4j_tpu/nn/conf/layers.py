@@ -351,6 +351,63 @@ class EmbeddingLayer(FeedForwardLayer):
         return self._act("identity" if self.activation is None else self.activation)(z), state
 
 
+@register_layer("embedding_sequence")
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(FeedForwardLayer):
+    """Token-id sequence embedding: int indices [b, t] (or [b, t, 1]) →
+    [b, t, n_out] vectors (parity: nn/conf/layers/EmbeddingSequenceLayer.java).
+
+    The realistic-vocab LM input path: at V ≫ 1k a one-hot [b, t, V] input
+    cannot survive host memory, so the network takes raw ids and this
+    layer gathers rows of W — on TPU a dynamic-gather, VMEM-friendly and
+    free of the one-hot matmul's V-wide FLOPs. ``n_in`` is the VOCAB size
+    and must be given explicitly (the [b, t] id input carries no feature
+    dim to infer it from). Ids must stay integer-typed end to end — never
+    cast through a compute dtype (bf16 rounds ids past 256)."""
+
+    has_bias: bool = False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def set_n_in(self, input_type: InputType, override: bool = False) -> None:
+        if self.n_in is None:
+            raise ValueError(
+                "EmbeddingSequenceLayer needs n_in=<vocab size> set "
+                "explicitly — the [b, t] id input has no feature dim to "
+                "infer it from")
+
+    def preprocessor_for(self, input_type: InputType):
+        return None     # ids are consumed raw — never reshaped/cast
+
+    def param_shapes(self, policy=None):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key, policy=None):
+        params = super().init_params(key, policy)
+        if not self.has_bias:
+            params.pop("b", None)
+        return params
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None, policy=None):
+        policy = policy or _dtypes.default_policy()
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        emb = jnp.take(params["W"], idx, axis=0).astype(policy.compute_dtype)
+        if self.has_bias:
+            emb = emb + params["b"].astype(emb.dtype)
+        out = self._act("identity" if self.activation is None
+                        else self.activation)(emb)
+        if mask is not None:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out, state
+
+
 # --------------------------------------------------------------------------
 # convolutional family
 # --------------------------------------------------------------------------

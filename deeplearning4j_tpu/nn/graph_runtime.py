@@ -444,7 +444,9 @@ class ComputationGraph:
                                                      minibatch_size=mb)
         score_arr = v.layer.compute_score_array(
             params[name], hidden, y, mask=out_mask, policy=self.policy)
-        denom = _losses.masked_denominator(out_mask, y, score_arr.shape[0])
+        denom = _losses.masked_denominator(
+            out_mask, y, score_arr.shape[0],
+            sparse=_losses.is_sparse(v.layer.loss))
         return jnp.sum(score_arr) / denom
 
     def _loss_fn_segmented(self, params, states, inputs, labels, rng):

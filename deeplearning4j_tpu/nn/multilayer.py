@@ -351,7 +351,9 @@ class MultiLayerNetwork:
         score_arr = out_layer.compute_score_array(
             params[_layer_key(out_idx)], hidden, y, mask=out_mask,
             policy=self.policy)
-        denom = _losses.masked_denominator(out_mask, y, score_arr.shape[0])
+        denom = _losses.masked_denominator(
+            out_mask, y, score_arr.shape[0],
+            sparse=_losses.is_sparse(out_layer.loss))
         loss = jnp.sum(score_arr) / denom
         loss = loss + self._reg_penalty(params)
         # layers may surface auxiliary objectives through their state

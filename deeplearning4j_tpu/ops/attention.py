@@ -85,8 +85,29 @@ def _block_attend(q, k, v, m_prev, num_prev, den_prev, *, scale,
     return m_new, num, den
 
 
+def ring_flash_available(t_local: int) -> bool:
+    """Should ring attention run its hops through the Pallas flash kernel?
+
+    Same trace-time contract as ``flash_attention.flash_available``:
+    ``DL4JTPU_FLASH_ATTENTION=1`` forces the kernel-in-ring path at any
+    length (interpret-mode off-TPU, so CPU test meshes exercise the real
+    carry/VJP protocol), ``0`` forces the JAX-level online-softmax block
+    (the parity oracle), unset = auto — on for per-device shards of
+    t_local ≥ 1024 on the TPU backend. Non-divisible t_local is handled
+    by the flash path itself (end-of-shard padding under a key mask), so
+    divisibility never forces the oracle."""
+    import os
+    flag = os.environ.get("DL4JTPU_FLASH_ATTENTION", "auto")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    return t_local >= 1024 and jax.devices()[0].platform == "tpu"
+
+
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None, mask=None):
+                   scale: Optional[float] = None, mask=None,
+                   impl: Optional[str] = None):
     """Ring attention INSIDE a shard_map over `axis_name`.
 
     Each device holds a [b, t_local, h, d] shard of q/k/v (the global
@@ -99,7 +120,17 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     rotates around the ring WITH its K/V shard, so padded keys anywhere in
     the global sequence are excluded; fully-masked query rows output 0
     (same semantics as ``dot_product_attention``).
+
+    ``impl``: ``"flash"`` runs every hop through the Pallas flash kernel
+    (forward AND backward — see ``_ring_flash_attention``), ``"jax"``
+    keeps the JAX-level online-softmax block below (the parity oracle),
+    ``None`` routes via :func:`ring_flash_available` at trace time.
     """
+    if impl is None:
+        impl = "flash" if ring_flash_available(q.shape[1]) else "jax"
+    if impl == "flash":
+        return _ring_flash_attention(q, k, v, mask, axis_name=axis_name,
+                                     causal=causal, scale=scale)
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     d = q.shape[-1]
@@ -142,6 +173,180 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [b,tq,h,d]
 
 
+# --------------------------------------------------------------------------
+# ring-flash: every hop through the Pallas flash kernel, fwd AND bwd
+# --------------------------------------------------------------------------
+#
+# Protocol (see flash_attention.flash_attention_block): each device keeps an
+# online-softmax carry (m, l, o) for its LOCAL queries; every visiting K/V
+# shard is one flash-kernel call folded into the carry. Cross-hop causal
+# masking needs no dynamic offsets inside the kernel — a hop pair
+# (q from device ``idx``, k/v born on device ``src``) is entirely
+# pre-diagonal (src < idx → plain non-causal kernel), on the diagonal
+# (src == idx → causal kernel), or entirely post-diagonal (src > idx →
+# skipped, no kernel at all), selected with ``lax.switch`` on the traced
+# hop index. The backward is a SECOND ring over the same ``ppermute``
+# permutation: dq accumulates locally from the per-hop flash backward
+# kernels (P recomputed from the saved full-sequence lse), while dk/dv
+# accumulators travel WITH their K/V shard and arrive home after the full
+# rotation.
+
+
+def _ring_hop_branches(q32, scale, block_q, interpret):
+    """(full, diag, skip) forward-hop branches for ``lax.switch``."""
+    from .flash_attention import flash_attention_block
+
+    def full(c, kb, vb, mb):
+        return flash_attention_block(q32, kb, vb, c, causal=False,
+                                     scale=scale, mask=mb, block_q=block_q,
+                                     interpret=interpret)
+
+    def diag(c, kb, vb, mb):
+        return flash_attention_block(q32, kb, vb, c, causal=True,
+                                     scale=scale, mask=mb, block_q=block_q,
+                                     interpret=interpret)
+
+    def skip(c, kb, vb, mb):
+        return c
+
+    return full, diag, skip
+
+
+def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, block_q,
+                         interpret):
+    from .flash_attention import flash_carry_finalize, flash_carry_init
+    n = jax.lax.psum(1, axis_name)
+    # axis_index only when the hop trichotomy needs it: a dangling
+    # partition-id in the non-causal program trips the CPU SPMD
+    # partitioner (PartitionId outside a recognized manual region)
+    idx = jax.lax.axis_index(axis_name) if causal else 0
+    q32 = q.astype(jnp.float32)
+    full, diag, skip = _ring_hop_branches(q32, scale, block_q, interpret)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, st):
+        c, kb, vb, mb = st
+        src = jnp.mod(idx - i, n)
+        if causal:
+            branch = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+            c = jax.lax.switch(branch, (full, diag, skip), c, kb, vb, mb)
+        else:
+            c = full(c, kb, vb, mb)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        mb = jax.lax.ppermute(mb, axis_name, perm)
+        return c, kb, vb, mb
+
+    carry, *_ = jax.lax.fori_loop(
+        0, n, body, (flash_carry_init(q32), k, v, mask))
+    out32, lse = flash_carry_finalize(carry)
+    return out32, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_flash_core(q, k, v, mask, axis_name, causal, scale, block_q,
+                     interpret):
+    out32, _ = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal,
+                                    scale, block_q, interpret)
+    return out32.astype(q.dtype)
+
+
+def _ring_flash_fwd_rule(q, k, v, mask, axis_name, causal, scale, block_q,
+                         interpret):
+    out32, lse = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal,
+                                      scale, block_q, interpret)
+    return out32.astype(q.dtype), (q, k, v, mask, out32, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, scale, block_q, interpret,
+                         res, g):
+    from .flash_attention import flash_attention_bwd_block
+    q, k, v, mask, out32, lse = res
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name) if causal else 0  # see fwd note
+    q32 = q.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(kb, vb, mb, diag):
+        return flash_attention_bwd_block(
+            q32, kb.astype(jnp.float32), vb.astype(jnp.float32), out32,
+            lse, g32, causal=diag, scale=scale, mask=mb, block_q=block_q,
+            interpret=interpret)
+
+    def full(kb, vb, mb):
+        return hop(kb, vb, mb, False)
+
+    def diag(kb, vb, mb):
+        return hop(kb, vb, mb, True)
+
+    def skip(kb, vb, mb):
+        z = jnp.zeros_like(q32)
+        return z, jnp.zeros_like(z), jnp.zeros_like(z)
+
+    def body(i, st):
+        dq, dk, dv, kb, vb, mb = st
+        src = jnp.mod(idx - i, n)
+        if causal:
+            branch = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+            dq_h, dk_h, dv_h = jax.lax.switch(
+                branch, (full, diag, skip), kb, vb, mb)
+        else:
+            dq_h, dk_h, dv_h = full(kb, vb, mb)
+        dq = dq + dq_h.astype(jnp.float32)
+        dk = dk + dk_h.astype(jnp.float32)
+        dv = dv + dv_h.astype(jnp.float32)
+        # dk/dv accumulators travel WITH their shard: after the full
+        # rotation each lands back on its home device, complete
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        mb = jax.lax.ppermute(mb, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        return dq, dk, dv, kb, vb, mb
+
+    zeros = jnp.zeros_like(q32)
+    dq, dk, dv, *_ = jax.lax.fori_loop(
+        0, n, body, (zeros, jnp.zeros_like(zeros), jnp.zeros_like(zeros),
+                     k, v, mask))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            jnp.zeros_like(mask))
+
+
+_ring_flash_core.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def _ring_flash_attention(q, k, v, mask, *, axis_name: str, causal: bool,
+                          scale: Optional[float],
+                          block_q: Optional[int] = None):
+    """Flash-kernel ring attention on the LOCAL shards (inside shard_map).
+
+    Handles ragged shards here, outside the custom VJP: t_local that does
+    not divide the flash tile is padded at the END of every shard (keys
+    masked out, query rows sliced off after), which preserves global
+    causal order because the hop trichotomy (pre/diagonal/post) only
+    compares shard indices. ``interpret`` is resolved at trace time so CPU
+    meshes run the kernels in interpret mode."""
+    t_local = q.shape[1]
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / float(d) ** 0.5
+    interpret = jax.devices()[0].platform != "tpu"
+    bq = block_q or (128 if t_local >= 128 else -(-t_local // 8) * 8)
+    pad = (-t_local) % bq
+    if mask is None:
+        mask = jnp.ones((q.shape[0], t_local), jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    out = _ring_flash_core(q, k, v, mask, axis_name, causal, scale, bq,
+                           interpret)
+    return out[:, :t_local] if pad else out
+
+
 def make_ring_attention(mesh, axis_name: str = "seq", *,
                         causal: bool = False, batch_axis: Optional[str] = None,
                         with_mask: bool = False):
@@ -164,18 +369,20 @@ def make_ring_attention(mesh, axis_name: str = "seq", *,
 
     spec = P(batch_axis, axis_name, None, None)
     mspec = P(batch_axis, axis_name)
+    # check_rep=False: the flash route's pallas_call has no shard_map
+    # replication rule (the ring touches no replicated operands anyway —
+    # everything it moves is axis-sharded)
+    smap = functools.partial(shard_map, mesh=mesh, check_rep=False)
 
     if with_mask:
-        @functools.partial(shard_map, mesh=mesh,
-                           in_specs=(spec, spec, spec, mspec),
+        @functools.partial(smap, in_specs=(spec, spec, spec, mspec),
                            out_specs=spec)
         def fn(q, k, v, mask):
             return ring_attention(q, k, v, axis_name=axis_name,
                                   causal=causal, mask=mask)
         return fn
 
-    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    @functools.partial(smap, in_specs=(spec, spec, spec), out_specs=spec)
     def fn(q, k, v):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
 

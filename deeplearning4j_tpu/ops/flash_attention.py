@@ -508,12 +508,146 @@ def _flash_bwd_btd_pallas(q, k, v, mk, out, lse, dout, *, scale, causal,
 
 
 # --------------------------------------------------------------------------
+# block-callable entry: online-softmax carry across flash calls
+# --------------------------------------------------------------------------
+#
+# The ring sequence-parallel path (ops.attention.ring_attention) holds one
+# local Q shard and sees K/V one visiting shard per hop.  These three
+# functions let each hop run the SAME Pallas forward kernel on (local q,
+# visiting k/v) and fold the hop's result into an online-softmax carry
+# (running max ``m``, normalizer ``l``, accumulator ``o``), so the
+# full-sequence softmax is exact without the [t, t] matrix ever existing —
+# on any device, at any hop.  Cross-hop causal masking is resolved by the
+# CALLER into one of two static kernel modes (every hop pair is either
+# entirely pre-diagonal → ``causal=False``, on the diagonal →
+# ``causal=True``, or entirely post-diagonal → skipped), so the kernels
+# never need dynamic global offsets.
+
+
+def flash_carry_init(q):
+    """Fresh (m, l, o) carry for a [b, t, h, d] query block: running max
+    ``m`` [b,t,h] at NEG_INF, normalizer ``l`` [b,t,h] at 0, accumulator
+    ``o`` [b,t,h,d] at 0 — all float32 regardless of q's dtype (the carry
+    is the accumulation domain)."""
+    b, t, h, d = q.shape
+    return (jnp.full((b, t, h), NEG_INF, jnp.float32),
+            jnp.zeros((b, t, h), jnp.float32),
+            jnp.zeros((b, t, h, d), jnp.float32))
+
+
+def flash_attention_block(q, k, v, carry, *, causal=False, scale=None,
+                          mask=None, block_q=None, interpret=False):
+    """One carry update: flash-tiled attention of q [b,tq,h,d] against ONE
+    k/v block [b,tk,h,d], folded into ``carry`` (from
+    :func:`flash_carry_init` or a previous call).  The Pallas forward
+    kernel does the tiled work and emits this block's (out, lse); the fold
+    is the standard log-space online-softmax merge, exact and
+    order-independent.
+
+    ``causal=True`` means q and k/v occupy the SAME global time offset
+    (the diagonal block); pre-diagonal blocks are ``causal=False`` and
+    post-diagonal blocks must simply not be fed.  ``mask``: optional
+    [b, tk] key-validity for THIS block.  Rows that have seen no
+    attendable key anywhere keep m=NEG_INF / l=0 and finalize to 0."""
+    m, l, o = carry
+    b, t, h, d = q.shape
+    if k.shape[1] != t:
+        raise ValueError(
+            f"flash_attention_block needs len(k) == len(q) (got "
+            f"{k.shape[1]} vs {t}) — ring hops are shard-sized; pad the "
+            "shorter side under a key mask instead")
+    if mask is None:
+        mask = jnp.ones((k.shape[0], k.shape[1]), jnp.float32)
+    out_h, lse_h = _core_fwd(q, k, v, jnp.asarray(mask, jnp.float32),
+                             causal, scale, block_q, interpret)
+    lse_h = lse_h.reshape(b, h, t).transpose(0, 2, 1)       # [b, t, h]
+    m_new = jnp.maximum(m, lse_h)
+    m_safe = jnp.where(m_new <= _HALF_NEG, 0.0, m_new)
+    corr = jnp.where(m <= _HALF_NEG, 0.0, jnp.exp(m - m_safe))
+    w = jnp.where(lse_h <= _HALF_NEG, 0.0, jnp.exp(lse_h - m_safe))
+    o = o * corr[..., None] + out_h.astype(jnp.float32) * w[..., None]
+    l = l * corr + w
+    return m_new, l, o
+
+
+def flash_carry_finalize(carry):
+    """(out [b,t,h,d] f32, lse [b,t,h] f32) from an (m, l, o) carry.
+    Rows that never saw an attendable key → out 0, lse NEG_INF — the same
+    semantics as the monolithic kernel."""
+    m, l, o = carry
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    return out, lse
+
+
+def flash_attention_bwd_block(q, k, v, out, lse, dout, *, causal=False,
+                              scale=None, mask=None, block_q=None,
+                              interpret=False):
+    """Per-block flash backward for the ring VJP: given the FINAL output,
+    its cotangent, and the FULL-sequence lse (all [b,tq,h,...], from
+    :func:`flash_carry_finalize`), return this (q, k/v)-block pair's
+    (dq, dk, dv) contributions — the standard flash backward recomputes P
+    per tile from the global lse, so per-block contributions sum exactly
+    to the dense gradient.  Same Pallas kernels as the monolithic
+    backward; ``DL4JTPU_FLASH_BWD=jax`` selects the lax.scan blockwise
+    fallback (read at trace time, like the monolithic path).  ``causal``
+    has the same diagonal-block meaning as :func:`flash_attention_block`."""
+    import os
+    b, t, h, d = q.shape
+    if k.shape[1] != t:
+        raise ValueError(
+            f"flash_attention_bwd_block needs len(k) == len(q) (got "
+            f"{k.shape[1]} vs {t}) — ring hops are shard-sized")
+    s = _resolve_scale(scale, d)
+    if mask is None:
+        mask = jnp.ones((k.shape[0], k.shape[1]), jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+    to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(
+        a.shape[0] * a.shape[2], a.shape[1], a.shape[3])
+    lse_b = lse.transpose(0, 2, 1).reshape(b * h, t)
+    use_jax = os.environ.get("DL4JTPU_FLASH_BWD") == "jax"
+    bq_bwd, bk_bwd = _bwd_tiles(t, block_q, pallas=not use_jax)
+    if use_jax:
+        mk = jnp.repeat(mask, h, axis=0)
+        dq, dk, dv = _flash_bwd_btd(
+            to_btd(q), to_btd(k), to_btd(v), mk, to_btd(out), lse_b,
+            to_btd(dout), scale=s, causal=causal, block_q=bq_bwd,
+            block_k=bk_bwd)
+    else:
+        dq, dk, dv = _flash_bwd_btd_pallas(
+            to_btd(q), to_btd(k), to_btd(v), mask, to_btd(out), lse_b,
+            to_btd(dout), scale=s, causal=causal, block_q=bq_bwd,
+            block_k=bk_bwd, interpret=interpret, n_heads=h)
+    back = lambda a, tt: a.reshape(b, h, tt, d).transpose(0, 2, 1, 3)
+    return back(dq, t), back(dk, k.shape[1]), back(dv, k.shape[1])
+
+
+# --------------------------------------------------------------------------
 # public op with custom_vjp
 # --------------------------------------------------------------------------
 
 
 def _resolve_scale(scale, d):
     return scale if scale is not None else 1.0 / float(d) ** 0.5
+
+
+def _bwd_tiles(t, block_q, pallas):
+    """Backward tile choice — ONE copy of the PERF.md sweep rationale for
+    both the monolithic VJP and the ring's per-hop backward. Pallas
+    kernels take 512×1024 when t allows (fastest point that fits the
+    16MB scoped-VMEM limit; 1024² OOMs, 256² is ~2× slower); the
+    lax.scan fallback has no VMEM ceiling, so it takes square 1024
+    tiles. ``block_q`` is the FALLBACK tile for non-divisible t (the
+    caller's forward/padding granule), not an override of the tuned
+    table."""
+    if t % 1024 == 0:
+        return (512, 1024) if pallas else (1024, 1024)
+    if t % 512 == 0:
+        return 512, 512
+    if pallas and t % 256 == 0:
+        return 256, 256
+    bq = block_q or 128
+    return bq, bq
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -544,34 +678,17 @@ def _core_bwd_rule(causal, scale, block_q, interpret, res, g):
     b, t, h, d = q.shape
     s = _resolve_scale(scale, d)
     to_btd = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    if os.environ.get("DL4JTPU_FLASH_BWD") == "jax":
+    use_jax = os.environ.get("DL4JTPU_FLASH_BWD") == "jax"
+    bq_bwd, bk_bwd = _bwd_tiles(t, block_q, pallas=not use_jax)
+    if use_jax:
         # JAX-blockwise fallback (same math, lax.scan tiles)
         mk = jnp.repeat(mask.astype(jnp.float32), h, axis=0)
-        if t % 1024 == 0:
-            bq_bwd = bk_bwd = 1024
-        elif t % 512 == 0:
-            bq_bwd = bk_bwd = 512
-        else:
-            bq_bwd = bk_bwd = block_q or 128
         dq, dk, dv = _flash_bwd_btd(
             to_btd(q), to_btd(k), to_btd(v), mk, to_btd(out), lse,
             to_btd(g), scale=s, causal=causal, block_q=bq_bwd,
             block_k=bk_bwd)
     else:
-        # Pallas backward kernels. Tile choice (PERF.md sweep): 256² is
-        # ~2× slower than the 512/1024 band, which is flat within the
-        # measurement noise — but 1024² allocates ~18MB of [bq,bk] f32
-        # intermediates on the VMEM stack and OOMs the 16MB scoped limit
-        # in some surrounding programs, so take 512×1024 (~8MB, fastest
-        # safe point) when t allows
-        if t % 1024 == 0:
-            bq_bwd, bk_bwd = 512, 1024
-        elif t % 512 == 0:
-            bq_bwd = bk_bwd = 512
-        elif t % 256 == 0:
-            bq_bwd = bk_bwd = 256
-        else:
-            bq_bwd = bk_bwd = block_q or 128
+        # tile choice: see _bwd_tiles (the PERF.md sweep rationale)
         dq, dk, dv = _flash_bwd_btd_pallas(
             to_btd(q), to_btd(k), to_btd(v), mask, to_btd(out), lse,
             to_btd(g), scale=s, causal=causal, block_q=bq_bwd,
@@ -585,13 +702,18 @@ _flash_core.defvjp(_core_fwd_rule, _core_bwd_rule)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
-                    interpret=False, mask=None):
+                    interpret=None, mask=None):
     """[b, t, h, d] attention with Pallas forward and backward kernels
     (``DL4JTPU_FLASH_BWD=jax`` selects the lax.scan blockwise backward
     instead). t must divide by ``block_q`` (default: auto — 128-row
     granularity, upgraded to wider tiles when t and the VMEM budget allow;
     an explicit ``block_q`` is used as-is). ``mask``: optional [b, t_kv]
-    key-validity mask (1=attend); rows with no attendable keys output 0."""
+    key-validity mask (1=attend); rows with no attendable keys output 0.
+    ``interpret``: None = auto at trace time — interpret-mode off-TPU, so
+    ``DL4JTPU_FLASH_ATTENTION=1`` exercises the kernel math on the CPU
+    test backend too."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
     if mask is None:
         mask = jnp.ones((q.shape[0], q.shape[1]), jnp.float32)
     return _flash_core(q, k, v, jnp.asarray(mask, jnp.float32), causal,

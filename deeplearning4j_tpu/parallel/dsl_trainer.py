@@ -91,6 +91,7 @@ class ShardedDSLTrainerBase:
                     placed[slot] = jax.device_put(
                         tree, NamedSharding(mesh, P()))
             net.updater_state = placed
+        self._x_spec = x_spec
         self._x_sharding = NamedSharding(mesh, x_spec)
         self._mask_sharding = NamedSharding(mesh, mask_spec)
         ctx = trace_ctx if trace_ctx is not None else contextlib.nullcontext
@@ -131,7 +132,14 @@ class ShardedDSLTrainerBase:
             new_states = _updaters.select_tree(ok, new_states, states)
             return params2, opt_state2, new_states, loss, ok
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        # jit caches keyed on util.xla.trace_env_key(): the attention
+        # routing flags (DL4JTPU_FLASH_ATTENTION/_BWD) are read at trace
+        # time INSIDE the ring — flipping one must retrace the sharded
+        # step under the new routing without manual cache clearing, the
+        # same contract the single-device runtimes' _jit_cache keeps
+        self._step_fn = step
+        self._fwd_fns = {}
+        self._step_fns = {}
 
         if self._is_graph:
             def fwd(params, states, inputs):
@@ -146,10 +154,27 @@ class ShardedDSLTrainerBase:
                                           train=False)
                 return [out]
 
-        self._fwd = jax.jit(fwd)
+        self._fwd_fn = fwd
+
+    def _step(self, *args):
+        from ..util import xla as _xla
+        return _xla.keyed_jit(self._step_fns, self._step_fn,
+                              donate_argnums=(0, 1))(*args)
+
+    def _fwd(self, *args):
+        from ..util import xla as _xla
+        return _xla.keyed_jit(self._fwd_fns, self._fwd_fn)(*args)
 
     def _stage(self, a):
-        return jax.device_put(jnp.asarray(a), self._x_sharding)
+        a = jnp.asarray(a)
+        sharding = self._x_sharding
+        spec = tuple(self._x_spec)
+        if a.ndim != len(spec):
+            # integer-id inputs ([b, t] instead of [b, t, f]): shard by
+            # the spec's LEADING axes — batch/seq placement is identical,
+            # only the feature axis is absent
+            sharding = NamedSharding(self.mesh, P(*spec[:a.ndim]))
+        return jax.device_put(a, sharding)
 
     def _stage_mask(self, m):
         return jax.device_put(jnp.asarray(m), self._mask_sharding)

@@ -101,7 +101,6 @@ class SequenceParallelTrainer:
         n_heads_ = self.n_heads
         lr = self.lr
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
         def step(params, x, y):
             loss, grads = jax.value_and_grad(lm_loss)(
                 params, x, y, n_heads=n_heads_, attention_fn=ring)
@@ -109,19 +108,29 @@ class SequenceParallelTrainer:
                 lambda p, g: p - lr * g, params, grads)
             return params, loss
 
-        self._step = step
-        self._forward = jax.jit(functools.partial(
-            block_apply, n_heads=n_heads_, attention_fn=ring))
+        # jits keyed on trace_env_key: the ring's flash-vs-jax routing is
+        # read at trace time, so a flag flip must retrace (same contract
+        # as the net runtimes' _jit_cache)
+        self._step_fn = step
+        self._forward_fn = functools.partial(
+            block_apply, n_heads=n_heads_, attention_fn=ring)
+        self._step_fns = {}
+        self._forward_fns = {}
 
     def _stage(self, a):
         return jax.device_put(jnp.asarray(a), self._x_sharding)
 
     def forward(self, x):
-        return self._forward(self.params, self._stage(x))
+        from ..util import xla as _xla
+        fwd = _xla.keyed_jit(self._forward_fns, self._forward_fn)
+        return fwd(self.params, self._stage(x))
 
     def fit_batch(self, x, y) -> jax.Array:
-        self.params, loss = self._step(self.params, self._stage(x),
-                                       self._stage(y))
+        from ..util import xla as _xla
+        step = _xla.keyed_jit(self._step_fns, self._step_fn,
+                              donate_argnums=(0,))
+        self.params, loss = step(self.params, self._stage(x),
+                                 self._stage(y))
         return loss
 
 

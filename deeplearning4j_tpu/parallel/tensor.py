@@ -120,11 +120,18 @@ class TensorParallelTrainer:
             params = _updaters.apply_updates(params, deltas)
             return params, opt_state, new_states, loss
 
+        # opt_state is DONATED, so its output sharding must equal its
+        # input sharding exactly — pin both to the placement shard_params
+        # chose (leaving it unconstrained lets GSPMD shard the output of
+        # a replicated-in slot, and the aliased buffers then differ in
+        # size: runtime INTERNAL error on the 2-D mesh)
+        opt_sh = jax.tree_util.tree_map(lambda a: a.sharding,
+                                        net.updater_state)
         return jax.jit(
             step, donate_argnums=(0, 1),
-            in_shardings=(param_sh, None, repl, batch_sh, batch_sh, batch_sh,
-                          repl, repl),
-            out_shardings=(param_sh, None, repl, repl))
+            in_shardings=(param_sh, opt_sh, repl, batch_sh, batch_sh,
+                          batch_sh, repl, repl),
+            out_shardings=(param_sh, opt_sh, repl, repl))
 
     def fit_batch(self, x, y, mask=None) -> float:
         net = self.net

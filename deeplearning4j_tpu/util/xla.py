@@ -77,6 +77,21 @@ def trace_env_key() -> str:
             f"|fabwd={os.environ.get('DL4JTPU_FLASH_BWD', 'pallas')}")
 
 
+def keyed_jit(cache: Dict[str, Any], fn: Callable, **jit_kw):
+    """ONE copy of the trace-env-keyed jit-cache lookup the sharded
+    trainers use: returns the jit of ``fn`` cached under the CURRENT
+    :func:`trace_env_key`, compiling a fresh one when a routing flag has
+    flipped since the cached trace (the trainer-side analog of the net
+    runtimes' ``_jit_cache`` key suffix)."""
+    import jax
+    key = trace_env_key()
+    jitted = cache.get(key)
+    if jitted is None:
+        jitted = jax.jit(fn, **jit_kw)
+        cache[key] = jitted
+    return jitted
+
+
 # ----------------------------------------------------------------------
 # retrace guard
 # ----------------------------------------------------------------------

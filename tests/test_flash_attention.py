@@ -196,6 +196,59 @@ class TestTraceTimeFlagRouting:
         net.fit_batch(x, y)
         assert len(net._jit_cache) == n
 
+    def test_ring_caller_retraces_on_toggle_flip(self, rng, monkeypatch):
+        """Ring callers honour the same contract: the sharded DSL
+        trainer's jitted step is keyed on trace_env_key, so flipping
+        DL4JTPU_FLASH_ATTENTION re-traces the step with the ring routed
+        through (or away from) the Pallas kernel — no manual cache
+        clearing — and flipping back reuses the original compilation."""
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        from deeplearning4j_tpu.parallel import (
+            SequenceParallelGraphTrainer, create_mesh)
+        monkeypatch.delenv("DL4JTPU_FLASH_ATTENTION", raising=False)
+        monkeypatch.delenv("DL4JTPU_FLASH_BWD", raising=False)
+        net = ComputationGraph(transformer_lm(
+            7, n_layers=1, d_model=8, n_heads=2, d_ff=16, updater="sgd",
+            learning_rate=0.05, seed=9)).init()
+        tr = SequenceParallelGraphTrainer(net, create_mesh({"seq": 4}))
+        ids = np.random.default_rng(3).integers(0, 7, (2, 17))
+        eye = np.eye(7, dtype=np.float32)
+        x, y = eye[ids[:, :-1]], eye[ids[:, 1:]]
+        tr.fit_batch(x, y)
+        keys0 = set(tr._step_fns)
+        tr.fit_batch(x, y)
+        assert set(tr._step_fns) == keys0       # steady state: one program
+
+        monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "1")
+        loss = tr.fit_batch(x, y)               # kernel-in-ring trace
+        assert np.isfinite(float(loss))
+        new = set(tr._step_fns) - keys0
+        assert len(new) == 1 and "fa=1" in new.pop()
+
+        monkeypatch.delenv("DL4JTPU_FLASH_ATTENTION")
+        n = len(tr._step_fns)
+        tr.fit_batch(x, y)                      # flip back: reuse, no growth
+        assert len(tr._step_fns) == n
+
+    def test_bespoke_sequence_trainer_keys_step_on_flags(
+            self, rng, monkeypatch):
+        from deeplearning4j_tpu.parallel import create_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            SequenceParallelTrainer)
+        monkeypatch.delenv("DL4JTPU_FLASH_ATTENTION", raising=False)
+        tr = SequenceParallelTrainer(d_model=8, d_ff=16, n_heads=2,
+                                     vocab=7, mesh=create_mesh({"seq": 4}),
+                                     seed=1)
+        ids = np.random.default_rng(5).integers(0, 7, (2, 17))
+        eye = np.eye(7, dtype=np.float32)
+        x, y = eye[ids[:, :-1]], eye[ids[:, 1:]]
+        tr.fit_batch(x, y)
+        monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "1")
+        assert np.isfinite(float(tr.fit_batch(x, y)))
+        assert any("fa=1" in k for k in tr._step_fns)
+        assert len(tr._step_fns) == 2
+
     def test_graph_runtime_keys_cache_on_flags(self, rng, monkeypatch):
         from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
         from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer

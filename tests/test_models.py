@@ -197,6 +197,78 @@ class TestTransformerLM:
         acc = (pred[:, 4:] == ids[:, 5:]).mean()
         assert acc > 0.8, acc
 
+    def test_integer_id_path_matches_one_hot(self, rng):
+        """input_ids=True (EmbeddingSequenceLayer gather + sparse_mcxent)
+        computes the SAME loss as the one-hot path with shared weights —
+        one-hot @ W ≡ W[ids], and sparse labels ≡ one-hot labels."""
+        import jax
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        V, T, b = 11, 12, 4
+        mk = lambda ids_mode: ComputationGraph(transformer_lm(
+            V, n_layers=2, d_model=16, n_heads=2, d_ff=32, seed=7,
+            input_ids=ids_mode)).init()
+        net_i, net_o = mk(True), mk(False)
+        po = jax.device_get(net_o.params)
+        po["embed"]["W"] = jax.device_get(net_i.params)["embed"]["W"]
+        net_o.params = jax.device_put(po)   # TDD bias is zero-init
+        ids = rng.integers(0, V, (b, T + 1)).astype(np.int32)
+        eye = np.eye(V, dtype=np.float32)
+        li = float(net_i.fit_batch([ids[:, :-1]], [ids[:, 1:]]))
+        lo = float(net_o.fit_batch([eye[ids[:, :-1]]], [eye[ids[:, 1:]]]))
+        assert li == pytest.approx(lo, abs=1e-4)
+
+    def test_integer_id_path_trains_and_serde(self, rng):
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        V, T = 9, 16
+        conf = transformer_lm(V, n_layers=2, d_model=16, n_heads=2,
+                              d_ff=32, learning_rate=1e-2, seed=0,
+                              input_ids=True)
+        conf = ComputationGraphConfiguration.from_json(conf.to_json())
+        net = ComputationGraph(conf).init()
+        ids = np.array([[(i + j) % V for i in range(T + 1)]
+                        for j in range(8)], dtype=np.int32)
+        x, y = ids[:, :-1], ids[:, 1:]
+        losses = [float(net.fit_batch([x], [y])) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+        # fit_repeated takes the int inputs too (the bench path)
+        out = net.fit_repeated([x], [y], 4)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_sparse_mcxent_equals_dense_mcxent(self, rng):
+        from deeplearning4j_tpu import losses as L
+        logits = rng.normal(size=(3, 5, 7)).astype(np.float32)
+        ids = rng.integers(0, 7, (3, 5))
+        eye = np.eye(7, dtype=np.float32)
+        sparse = L.score_array("sparse_mcxent", ids, logits, "softmax")
+        dense = L.score_array("mcxent", eye[ids], logits, "softmax")
+        np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                                   rtol=1e-6, atol=1e-6)
+        # per-timestep mask denominator matches the dense convention —
+        # declared by the caller from the loss identity (is_sparse), so
+        # dense losses fed integer-typed labels keep the per-output
+        # contract
+        assert L.is_sparse("sparse_mcxent") and not L.is_sparse("mcxent")
+        mask = np.ones((3, 5), np.float32)
+        mask[:, 3:] = 0.0
+        d_sparse = L.masked_denominator(mask, np.asarray(ids), 3,
+                                        sparse=True)
+        d_dense = L.masked_denominator(mask, eye[ids], 3)
+        assert float(d_sparse) == float(d_dense) == 9.0
+        d_int_dense = L.masked_denominator(mask, np.asarray(ids), 3)
+        assert float(d_int_dense) == 3.0    # per-output: active rows
+        with pytest.raises(ValueError, match="softmax"):
+            L.get("sparse_mcxent")(ids, logits, "identity")
+        # out-of-range ids must poison the loss (NaN), never silently
+        # clamp to the last class
+        bad = np.array(ids)
+        bad[0, 0] = 7                       # == n_out: off-by-one vocab bug
+        per = np.asarray(L.get("sparse_mcxent")(bad, logits, "softmax"))
+        assert np.isnan(per[0, 0]) and np.isfinite(per[1:]).all()
+
     def test_causality_end_to_end(self, rng):
         from deeplearning4j_tpu.models import transformer_lm
         from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
