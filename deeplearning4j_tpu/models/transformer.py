@@ -26,9 +26,13 @@ Two input contracts:
 
 from __future__ import annotations
 
+from typing import List, Optional
+
+import numpy as np
+
 from ..nn.conf.attention import SelfAttentionLayer
 from ..nn.conf.builders import NeuralNetConfiguration
-from ..nn.conf.graph import ElementWiseVertex
+from ..nn.conf.graph import ElementWiseVertex, LayerVertex
 from ..nn.conf.inputs import InputType
 from ..nn.conf.layers import (EmbeddingSequenceLayer, LayerNormalization,
                               RnnOutputLayer)
@@ -40,7 +44,8 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
                    updater: str = "adam", learning_rate: float = 3e-4,
                    seed: int = 42, dtype: str = "float32",
                    moe_experts: int = 0, moe_top_k: int = 2,
-                   input_ids: bool = False):
+                   input_ids: bool = False,
+                   max_cache_t: Optional[int] = None):
     """Causal LM: in-proj → n_layers × [ln → attention (+res) → ln → ffn
     (+res)] → final ln → vocab head.
 
@@ -51,7 +56,12 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
     ``parallel.expert.ExpertParallelGraphTrainer``.
 
     ``input_ids=True`` switches to the integer-id contract (see module
-    docstring): feed [b, t] int32 ids, label with [b, t] int32 ids."""
+    docstring): feed [b, t] int32 ids, label with [b, t] int32 ids.
+
+    ``max_cache_t`` arms every block's attention with a streaming K/V
+    cache of that many positions — required for autoregressive decode
+    (:func:`generate` / the paged serving engine); overflowing it slides
+    the attention window (see ``SelfAttentionLayer.cache_overflow``)."""
     if d_model % n_heads:
         raise ValueError(f"d_model={d_model} not divisible by "
                          f"n_heads={n_heads}")
@@ -77,7 +87,8 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
         gb.add_layer(f"{b}_ln1", LayerNormalization(), prev)
         gb.add_layer(f"{b}_attn",
                      SelfAttentionLayer(n_in=d_model, n_out=d_model,
-                                        n_heads=n_heads, causal=True),
+                                        n_heads=n_heads, causal=True,
+                                        max_cache_t=max_cache_t),
                      f"{b}_ln1")
         gb.add_vertex(f"{b}_res1", ElementWiseVertex(op="add"),
                       prev, f"{b}_attn")
@@ -111,3 +122,123 @@ def transformer_lm(vocab_size: int, *, n_layers: int = 4,
     gb.set_outputs("out")
     gb.set_input_types(InputType.recurrent(1 if input_ids else vocab_size))
     return gb.build()
+
+
+# --------------------------------------------------------------------------
+# autoregressive decode
+# --------------------------------------------------------------------------
+
+
+def attention_vertices(net) -> List[str]:
+    """Topo-ordered names of the net's causal ``SelfAttentionLayer``
+    vertices — the layers that own a K/V cache (dense or paged) during
+    decode."""
+    names = []
+    for name in net.topo_order:
+        v = net.conf.vertices[name]
+        layer = v.layer if isinstance(v, LayerVertex) else None
+        if isinstance(layer, SelfAttentionLayer) and layer.causal:
+            names.append(name)
+    return names
+
+
+def sample_token(probs, temperature: float = 0.0, rng=None) -> int:
+    """Next-token choice from a softmax row — host-side, shared by the
+    full-cache oracle (:func:`generate`) and the paged serving engine so
+    the two paths CANNOT diverge in how they read the same distribution.
+    ``temperature <= 0`` is greedy (argmax); otherwise softmax sampling at
+    the given temperature from ``rng`` (a ``numpy.random.Generator``)."""
+    p = np.asarray(probs, dtype=np.float64).reshape(-1)
+    if temperature <= 0.0:
+        return int(np.argmax(p))
+    if rng is None:
+        raise ValueError("temperature sampling needs an rng")
+    logits = np.log(np.maximum(p, 1e-30)) / float(temperature)
+    logits -= logits.max()
+    e = np.exp(logits)
+    e /= e.sum()
+    return int(rng.choice(len(e), p=e))
+
+
+def generate(net, prompt_ids, max_new_tokens: int, *,
+             temperature: float = 0.0, eos_id: Optional[int] = None,
+             rng=None) -> np.ndarray:
+    """Single-sequence full-cache autoregressive decode through the
+    streaming ``rnn_time_step`` path — the offline API AND the parity
+    oracle the continuous-batching serving engine is pinned bit-exact
+    against (greedy; ``tests/test_decode.py``).
+
+    The net must be an ids-mode ``transformer_lm`` built with
+    ``max_cache_t`` set (the dense K/V window). Returns the generated ids
+    as int32 (≤ ``max_new_tokens``; stops early at ``eos_id``, which is
+    included in the output)."""
+    from ..util.netutil import streaming_cache_limit
+    limit = streaming_cache_limit(net)
+    if limit is None:
+        raise ValueError(
+            "generate() needs streaming K/V caches — build the net with "
+            "transformer_lm(..., max_cache_t=...)")
+    prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+    if prompt.size < 1:
+        raise ValueError("generate() needs a non-empty prompt")
+    net.rnn_clear_previous_state()
+    # the first window of the prompt goes in one chunk; any tail past
+    # the window is fed token by token — eviction is chunk-granular
+    # (the whole chunk's worth is evicted before its queries attend),
+    # so single-token feeding is what gives every position the exact
+    # (p - max_cache_t, p] sliding window
+    first = min(len(prompt), limit)
+    out = net.rnn_time_step(prompt[None, :first, None])
+    for i in range(first, len(prompt)):
+        out = net.rnn_time_step(prompt[None, i:i + 1, None])
+    probs = np.asarray(out)[0, -1]
+    toks: List[int] = []
+    for i in range(int(max_new_tokens)):
+        t = sample_token(probs, temperature, rng)
+        toks.append(t)
+        if (eos_id is not None and t == eos_id) \
+                or i == int(max_new_tokens) - 1:
+            break
+        step = net.rnn_time_step(np.full((1, 1, 1), t, np.int32))
+        probs = np.asarray(step)[0, -1]
+    return np.asarray(toks, np.int32)
+
+
+def paged_decode_forward(net, params, k_pools, v_pools, ids, page_tables,
+                         write_slots, rel_pos):
+    """ONE traced forward of an ids-mode ``transformer_lm`` graph in
+    paged-decode mode: every causal attention vertex reads/writes the
+    block pools through the lanes' page tables
+    (``SelfAttentionLayer.apply_paged``); every other vertex applies
+    exactly as in ``output()``. Pure w.r.t. its arguments, so the serving
+    engine jits it once per (lanes, chunk) bucket and admission/
+    retirement only ever change array CONTENTS.
+
+    ids: ``[S, t_new]`` int32 (padded lanes: any value — their writes are
+    dropped and their outputs ignored); page_tables: ``[S, P]``;
+    write_slots: ``[S, t_new]`` view-relative slots (-1 = dropped);
+    rel_pos: ``[S]``. Returns ``(probs [S, t_new, V], k_pools,
+    v_pools)``.
+    """
+    attn = attention_vertices(net)
+    if len(attn) != len(k_pools):
+        raise ValueError(
+            f"{len(k_pools)} pools for {len(attn)} attention vertices")
+    pool_ix = {n: i for i, n in enumerate(attn)}
+    k_pools, v_pools = list(k_pools), list(v_pools)
+    acts = {net.conf.network_inputs[0]: ids[:, :, None]}
+    mbs = net._minibatch_map(ids.shape[0])
+    for name in net.topo_order:
+        in_names = net.conf.vertex_inputs[name]
+        i = pool_ix.get(name)
+        if i is not None:
+            layer = net.conf.vertices[name].layer
+            out, k_pools[i], v_pools[i] = layer.apply_paged(
+                params[name], acts[in_names[0]], k_pools[i], v_pools[i],
+                page_tables, write_slots, rel_pos, policy=net.policy)
+        else:
+            out, _ = net._apply_vertex(name, params[name], acts, {}, None,
+                                       train=False,
+                                       minibatch=mbs[in_names[0]])
+        acts[name] = out
+    return acts[net.conf.network_outputs[0]], k_pools, v_pools

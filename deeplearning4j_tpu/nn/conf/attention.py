@@ -45,11 +45,19 @@ class SelfAttentionLayer(Layer):
     # cache — rnn_time_step then attends WITHIN each fed chunk only (no
     # history), which is almost never what you want for attention; set
     # max_cache_t for true incremental decode. Feeding more than
-    # max_cache_t TOTAL steps clamps (the tail overwrites); the runtimes
-    # count fed steps host-side and emit a RuntimeWarning at the first
+    # max_cache_t TOTAL steps slides the window: the OLDEST cached
+    # positions are evicted (positions stay global, so the causal masks
+    # remain correct) and the runtimes emit a RuntimeWarning at the first
     # overflow (util.netutil.note_streamed_steps) — reset with
     # rnn_clear_previous_state() between sequences. Causal layers only.
     max_cache_t: Optional[int] = None
+    # what overflowing max_cache_t means: "evict" = sliding-window
+    # attention over the most recent max_cache_t positions (the default,
+    # and what the paged serving arena does page-at-a-time); "strict" =
+    # the runtimes raise util.netutil.StreamingCacheOverflow host-side
+    # BEFORE the overflowing dispatch (for callers whose correctness
+    # depends on full history)
+    cache_overflow: str = "evict"
 
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.n_out or self.n_in,
@@ -111,6 +119,10 @@ class SelfAttentionLayer(Layer):
         if self.max_cache_t is None:
             raise ValueError(
                 "SelfAttentionLayer streaming needs max_cache_t set")
+        if self.cache_overflow not in ("evict", "strict"):
+            raise ValueError(
+                f"cache_overflow={self.cache_overflow!r} — expected "
+                "'evict' or 'strict'")
         if not self.causal:
             raise ValueError(
                 "SelfAttentionLayer streaming decode requires causal=True "
@@ -126,7 +138,28 @@ class SelfAttentionLayer(Layer):
     def _apply_streaming(self, params, xc, state, policy):
         """Incremental decode: append this chunk's K/V to the cache and
         attend the new queries over everything cached so far (causal
-        across calls). O(t_new · cached) instead of O(T²) per token."""
+        across calls). O(t_new · cached) instead of O(T²) per token.
+
+        Overflow is sliding-window EVICTION: once the fed total exceeds
+        ``max_cache_t`` the oldest cached positions are rolled out, so
+        the cache always holds the most recent ``max_cache_t`` tokens.
+        Positions stay GLOBAL — the in-band counter keeps counting fed
+        steps and the causal mask is computed in view-relative terms
+        (slot j holds global position ``base + j``).
+
+        Eviction is CHUNK-granular: the whole chunk's worth of old
+        positions is evicted before any of the chunk's queries attend,
+        so in an overflowing multi-step chunk query i sees
+        ``max_cache_t - (t_new - 1 - i)`` back-positions, not the full
+        window (the chunk's LAST query always sees exactly
+        ``(p - max_cache_t, p]``). Token-by-token decode (t_new=1 — the
+        decode loops' shape) therefore gets the exact per-token sliding
+        window; callers that need it for long prompts feed the
+        over-window tail in single steps (``models.transformer.
+        generate`` does). The paged serving arena makes the matching
+        choice at page granularity. Below the window this is a no-op
+        (shift 0) and the math is bit-identical to the pre-eviction
+        path."""
         b, t_new, f = xc.shape
         h = self.n_heads
         max_t = self.max_cache_t
@@ -140,21 +173,36 @@ class SelfAttentionLayer(Layer):
         q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         k_cache, v_cache = state["h"], state["c"]
         pos = k_cache[0, -1, 0].astype(jnp.int32)
-        pos = jnp.minimum(pos, max_t - t_new)   # clamp (documented)
+        # cache slot j holds global position base + j; this call may
+        # advance base (evict) so the t_new new tokens fit at the end
+        old_base = jnp.maximum(pos - max_t, 0)
+        new_base = jnp.maximum(pos + t_new - max_t, 0)
+        shift = new_base - old_base            # positions evicted now
+        write_pos = pos - new_base             # == min(pos, max_t - t_new)
+        # the roll is a whole-window gather — only pay it on the calls
+        # that actually evict (shift stays 0 until the window fills)
+        body_k, body_v = jax.lax.cond(
+            shift > 0,
+            lambda kv: (jnp.roll(kv[0], -shift, axis=1),
+                        jnp.roll(kv[1], -shift, axis=1)),
+            lambda kv: kv,
+            (k_cache[:, :max_t], v_cache[:, :max_t]))
         k_flat = k_new.reshape(b, t_new, f).astype(k_cache.dtype)
         v_flat = v_new.reshape(b, t_new, f).astype(v_cache.dtype)
         zero = jnp.zeros((), pos.dtype)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k_flat,
-                                               (zero, pos, zero))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v_flat,
-                                               (zero, pos, zero))
-        kh = k_cache[:, :max_t].reshape(b, max_t, h, f // h)
-        vh = v_cache[:, :max_t].reshape(b, max_t, h, f // h)
+        body_k = jax.lax.dynamic_update_slice(body_k, k_flat,
+                                              (zero, write_pos, zero))
+        body_v = jax.lax.dynamic_update_slice(body_v, v_flat,
+                                              (zero, write_pos, zero))
+        kh = body_k.reshape(b, max_t, h, f // h)
+        vh = body_v.reshape(b, max_t, h, f // h)
         scale = 1.0 / jnp.sqrt(f // h).astype(xc.dtype)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, kh) * scale
-        # new query i sits at global position pos+i: attend keys <= pos+i
+        # new query i sits at global position pos+i = view slot
+        # write_pos+i: attend view slots <= write_pos+i (evicted
+        # positions are simply absent from the view)
         key_idx = jnp.arange(max_t)
-        q_idx = pos + jnp.arange(t_new)
+        q_idx = write_pos + jnp.arange(t_new)
         allow = key_idx[None, :] <= q_idx[:, None]          # [t_new, max_t]
         logits = jnp.where(allow[None, None], logits.astype(jnp.float32),
                            -jnp.inf)
@@ -168,9 +216,50 @@ class SelfAttentionLayer(Layer):
         out = att.reshape(b, t_new, f) @ wo + params["b"].astype(att.dtype)
         out = self._act(self.activation or "identity")(out)
         new_pos = (pos + t_new).astype(k_cache.dtype)
-        k_cache = k_cache.at[:, -1, 0].set(new_pos)
-        v_cache = v_cache.at[:, -1, 0].set(new_pos)
+        k_cache = jnp.concatenate(
+            [body_k, k_cache[:, max_t:].at[:, 0, 0].set(new_pos)], axis=1)
+        v_cache = jnp.concatenate(
+            [body_v, v_cache[:, max_t:].at[:, 0, 0].set(new_pos)], axis=1)
         return out, {"h": k_cache, "c": v_cache}
+
+    def apply_paged(self, params, x, k_pool, v_pool, page_table,
+                    write_slots, rel_pos, *, policy=None):
+        """Paged-arena streaming decode (the serving continuous-batching
+        path): K/V live in shared ``[num_pages, page_size, h, d]`` block
+        pools instead of a per-sequence dense cache; each lane's page
+        table reassembles its window by gather. The math mirrors
+        :meth:`_apply_streaming` exactly — ``tests/test_decode.py`` pins
+        greedy decode through the arena bit-exact against the dense
+        full-cache path for sequences within the window. Sliding-window
+        overflow is PAGE eviction, done host-side by the serving engine
+        (page table shifts, ``rel_pos`` stays put); positions stay
+        global throughout, but past the window the paged and dense
+        paths legitimately differ by eviction granularity (a page vs a
+        token at a time).
+
+        x: ``[S, t_new, f]`` raw input activations; write_slots:
+        ``[S, t_new]`` view-relative write slots (-1 = padded, dropped);
+        rel_pos: ``[S]`` view-relative position of the first new query.
+        Returns ``(out, k_pool, v_pool)``.
+        """
+        from ...ops.paged_attention import (paged_attention, paged_gather,
+                                            paged_write)
+        policy = policy or _dtypes.default_policy()
+        xc, wqkv = policy.cast_to_compute(x, params["Wqkv"])
+        b, t_new, f = xc.shape
+        h = self.n_heads
+        qkv = (xc @ wqkv).reshape(b, t_new, 3, h, f // h)
+        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_pool = paged_write(k_pool, k_new, page_table, write_slots)
+        v_pool = paged_write(v_pool, v_new, page_table, write_slots)
+        kh = paged_gather(k_pool, page_table)
+        vh = paged_gather(v_pool, page_table)
+        scale = 1.0 / jnp.sqrt(f // h).astype(xc.dtype)
+        att = paged_attention(q, kh, vh, rel_pos, scale)
+        wo = params["Wo"].astype(att.dtype)
+        out = att.reshape(b, t_new, f) @ wo + params["b"].astype(att.dtype)
+        out = self._act(self.activation or "identity")(out)
+        return out, k_pool, v_pool
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None, policy=None):

@@ -42,6 +42,7 @@ from .. import rng as _rng
 from ..optimize import updaters as _updaters
 from ..util import xla as _xla
 from ..util.netutil import note_streamed_steps as _note_streamed_steps
+from ..util.netutil import precheck_streamed_steps as _precheck_streamed_steps
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.preprocessors import call_preprocessor
 
@@ -285,6 +286,9 @@ class MultiLayerNetwork:
             # carried cache
             self._rnn_state = self._zero_rnn_carry(x.shape[0])
             self._rnn_steps_fed = 0
+        # strict-mode streaming caches refuse the overflowing chunk
+        # host-side, before it can touch the cache
+        _precheck_streamed_steps(self, x.shape[1])
         cache_key = f"rnn_time_step@{_xla.trace_env_key()}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:

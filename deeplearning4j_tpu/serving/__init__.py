@@ -8,6 +8,11 @@ dependency-free HTTP server with request micro-batching (batches amortize
 dispatch and keep the MXU fed) and hot model swap.
 """
 
+from .decode import (DecodeRequest, DecodeScheduler, PagedDecodeEngine,
+                     SchedulerDraining, SchedulerSaturated)
+from .kv_cache import PagedKVArena, PageAllocator
 from .server import InferenceServer
 
-__all__ = ["InferenceServer"]
+__all__ = ["InferenceServer", "PagedDecodeEngine", "DecodeScheduler",
+           "DecodeRequest", "PagedKVArena", "PageAllocator",
+           "SchedulerSaturated", "SchedulerDraining"]

@@ -1,0 +1,822 @@
+"""Continuous-batching autoregressive decode over a paged KV arena.
+
+The generative-serving analog of the wave-batched ``/predict`` path
+(PAPERS: Orca/OSDI'22 in-flight batching + vLLM/SOSP'23 paged KV):
+instead of assembling a batch per request wave and holding every lane
+until the LONGEST sequence finishes, a persistent decode loop admits new
+sequences and retires finished ones (EOS / max-tokens / SLO deadline)
+at EVERY decode step, against a fixed-lane token budget. K/V lives in
+the shared :class:`~deeplearning4j_tpu.serving.kv_cache.PagedKVArena`,
+so a retiring sequence's pages are reusable by the next admission at the
+following step — the chip never idles on finished lanes and HBM never
+holds worst-case caches for short sequences.
+
+Two layers:
+
+- :class:`PagedDecodeEngine` — owns the model, the arena, and the
+  per-bucket jitted step (``models.transformer.paged_decode_forward``
+  through ``util.xla.keyed_jit``). The scheduler packs working lanes
+  into power-of-two batch buckets × two chunk lengths (1 for decode,
+  ``prefill_chunk`` for prefill) — a FIXED trace set, so admission and
+  retirement only ever change array contents and ``jit_retraces_total``
+  stays pinned at 1 per bucket (tested), while a lone admission
+  prefills at [1, C] cost instead of a full-width padded dispatch.
+- :class:`DecodeScheduler` — the continuous-batching policy: bounded
+  submit queue with shed-by-reason, page-reservation admission control,
+  chunked prefill interleaved with decode, per-sequence deadlines,
+  decode-aware ``drain()``, and a ``fence()`` that holds the loop at a
+  step boundary (mid-decode model swaps are refused through it).
+
+Greedy output through this path is BIT-EXACT against the single-sequence
+full-cache oracle (``models.transformer.generate``) for every sequence
+that stays within the window (prompt + generated ≤ page_size ×
+pages_per_seq): the paged gather reassembles the same dense window the
+oracle's streaming cache holds, and both paths share ``sample_token``.
+``tests/test_decode.py`` pins it. PAST the window the two legitimately
+diverge — the arena evicts a PAGE at a time while the oracle slides
+token-by-token, so their attention windows differ by up to
+``page_size - 1`` positions (both are valid sliding-window decodes;
+size the window to the service's max context where exactness past it
+matters).
+
+Observability (same metrics plane as the wave path): shed-by-reason
+rides ``serving_shed_total``; ``decode_batch_occupancy``,
+``kv_pages_in_use``, ``decode_retired_total{reason}``, TTFT and
+time-per-output-token histograms land in the scheduler's registry and
+the ``/metrics`` exposition when wired into an ``InferenceServer``.
+
+Fault seam: ``"serving.decode_step"`` before every prefill/decode
+dispatch (chaos tests script outages at exact step boundaries).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models import transformer as _transformer
+from ..nn.conf.attention import SelfAttentionLayer
+from ..nn.conf.layers import EmbeddingSequenceLayer
+from ..util import faults as _faults
+from ..util import metrics as _metrics
+from ..util import xla as _xla
+from ..util.resilience import SYSTEM_CLOCK, Clock, Deadline
+from .kv_cache import PagedKVArena
+
+__all__ = ["PagedDecodeEngine", "DecodeScheduler", "DecodeRequest",
+           "SchedulerSaturated", "SchedulerDraining"]
+
+
+class SchedulerSaturated(RuntimeError):
+    """Submit refused: the bounded request queue is full (shed — the
+    generative analog of the wave path's queue-full 503)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+
+
+class SchedulerDraining(RuntimeError):
+    """Submit refused: the scheduler is draining or stopped."""
+
+
+class DecodeRequest:
+    """Handle for one generative request: the scheduler appends tokens as
+    they are produced and signals ``event`` on finish. ``finish_reason``
+    ∈ {eos, max_tokens, deadline, error, shutdown}."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "eos_id",
+                 "deadline", "rng", "tokens", "finish_reason", "error",
+                 "event", "t_submit", "t_first_token", "t_done")
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 temperature: float, eos_id: Optional[int],
+                 deadline: Deadline, rng, t_submit: float):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.rng = rng
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.event = threading.Event()
+        self.t_submit = t_submit
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request finishes (True) or ``timeout`` real
+        seconds pass (False)."""
+        return self.event.wait(timeout)
+
+
+# sequence states inside the scheduler
+_PREFILL, _DECODE = "prefill", "decode"
+
+
+class _Sequence:
+    __slots__ = ("req", "lane", "state", "cursor", "last_token")
+
+    def __init__(self, req: DecodeRequest, lane: int):
+        self.req = req
+        self.lane = lane
+        self.state = _PREFILL
+        self.cursor = 0              # prompt tokens already prefilled
+        self.last_token = 0          # next token to feed in decode
+
+
+class PagedDecodeEngine:
+    """Model + arena + the per-bucket jitted paged step function.
+
+    ``max_batch`` is the lane count (the decode token budget per step);
+    ``page_size × pages_per_seq`` is each lane's attention window (longer
+    sequences slide by page eviction); ``num_pages`` defaults to the
+    worst case ``max_batch × pages_per_seq`` (no overcommit) — size it
+    smaller to let the scheduler queue admissions on page pressure.
+    """
+
+    def __init__(self, net, *, max_batch: int = 8, page_size: int = 16,
+                 pages_per_seq: int = 8, num_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 registry: Optional[_metrics.MetricsRegistry] = None):
+        import jax.numpy as jnp
+        self._validate_net(net)
+        self.net = net
+        self.lanes = int(max_batch)
+        self.page_size = int(page_size)
+        self.pages_per_seq = int(pages_per_seq)
+        self.window = self.page_size * self.pages_per_seq
+        if num_pages is None:
+            num_pages = self.lanes * self.pages_per_seq
+        if self.pages_per_seq > num_pages:
+            raise ValueError(
+                f"pages_per_seq={self.pages_per_seq} exceeds the arena "
+                f"({num_pages} pages) — one sequence could never run")
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk \
+            else min(16, self.window)
+        if not (1 <= self.prefill_chunk <= self.window):
+            raise ValueError(
+                f"prefill_chunk={self.prefill_chunk} must be in "
+                f"[1, window={self.window}]")
+        self.registry = registry if registry is not None \
+            else _metrics.MetricsRegistry()
+        self._check_decode_config(net)
+        attn = _transformer.attention_vertices(net)
+        dims = {}
+        for name in attn:
+            layer = net.conf.vertices[name].layer
+            dims[name] = (layer.n_heads, layer.n_in // layer.n_heads)
+        # same dtype rule as the dense streaming cache (_zero_state):
+        # at least f32, so bf16 compute policies keep exact K/V
+        dtype = jnp.promote_types(net.policy.compute_dtype, jnp.float32)
+        self.arena = PagedKVArena(dims, num_pages=int(num_pages),
+                                  page_size=self.page_size, dtype=dtype,
+                                  registry=self.registry)
+        # per-lane host state
+        s, p = self.lanes, self.pages_per_seq
+        self._tables = np.full((s, p), self.arena.sentinel, np.int32)
+        self._pos = np.zeros(s, np.int64)       # global fed positions
+        self._base = np.zeros(s, np.int64)      # evicted positions
+        self._held: List[List[int]] = [[] for _ in range(s)]
+        self._reserve_left = np.zeros(s, np.int64)
+        self._free_lanes = deque(range(s))
+        self._jit_cache: Dict[str, object] = {}
+        self.vocab = self._embed_vocab(net)
+
+    # -- construction-time validation ---------------------------------
+
+    @staticmethod
+    def _validate_net(net) -> None:
+        if not hasattr(net, "topo_order"):
+            raise ValueError(
+                "paged decode drives a ComputationGraph (transformer_lm)")
+        if net.params is None:
+            raise ValueError("net is not initialized — call init() first")
+        if (len(net.conf.network_inputs) != 1
+                or len(net.conf.network_outputs) != 1):
+            raise ValueError("paged decode needs exactly one input and "
+                             "one output vertex")
+        if not _transformer.attention_vertices(net):
+            raise ValueError("no causal SelfAttentionLayer vertices — "
+                             "nothing to cache")
+        in_name = net.conf.network_inputs[0]
+        consumers = [n for n in net.topo_order
+                     if in_name in net.conf.vertex_inputs[n]]
+        if not any(isinstance(getattr(net.conf.vertices[n], "layer", None),
+                              EmbeddingSequenceLayer) for n in consumers):
+            raise ValueError(
+                "paged decode requires the integer-id input path — build "
+                "with transformer_lm(..., input_ids=True)")
+        for name in net.topo_order:
+            v = net.conf.vertices[name]
+            layer = getattr(v, "layer", None)
+            if isinstance(layer, SelfAttentionLayer):
+                if not layer.causal:
+                    raise ValueError(
+                        f"vertex {name!r}: non-causal attention cannot "
+                        "decode incrementally")
+                continue
+            if layer is not None and hasattr(layer, "_zero_state"):
+                raise ValueError(
+                    f"vertex {name!r} ({type(layer).__name__}) carries "
+                    "recurrent state — paged decode supports attention-"
+                    "only sequence mixing")
+            if v.init_state(net.policy):
+                raise ValueError(
+                    f"vertex {name!r} carries persistent state — "
+                    "unsupported in paged decode")
+
+    def _check_decode_config(self, net) -> None:
+        """The net's own streaming-cache contract must agree with the
+        serving window, or served outputs silently diverge from the
+        offline oracle: strict layers forbid the sliding window
+        outright, and a dense ``max_cache_t`` different from
+        ``page_size × pages_per_seq`` means a different attention
+        window."""
+        for name in _transformer.attention_vertices(net):
+            layer = net.conf.vertices[name].layer
+            if getattr(layer, "cache_overflow", "evict") == "strict":
+                raise ValueError(
+                    f"vertex {name!r} sets cache_overflow='strict' — the "
+                    "paged serving window slides; serve an evict-mode "
+                    "net, or size page_size×pages_per_seq to the full "
+                    "context and cap max_new_tokens instead")
+            if (layer.max_cache_t is not None
+                    and layer.max_cache_t != self.window):
+                raise ValueError(
+                    f"vertex {name!r} max_cache_t={layer.max_cache_t} != "
+                    f"serving window {self.window} (page_size × "
+                    "pages_per_seq) — decode through the arena would "
+                    "diverge from the net's own streaming semantics")
+
+    @staticmethod
+    def _embed_vocab(net) -> int:
+        for name in net.topo_order:
+            layer = getattr(net.conf.vertices[name], "layer", None)
+            if isinstance(layer, EmbeddingSequenceLayer):
+                return int(layer.n_in)
+        return 0
+
+    # -- lane lifecycle ------------------------------------------------
+
+    def acquire_lane(self, total_tokens: int) -> Optional[int]:
+        """Admission: a free lane + a worst-case page reservation
+        (``min(pages_per_seq, pages(total_tokens))`` — window-capped), or
+        None when either is unavailable (the request stays queued)."""
+        if not self._free_lanes:
+            return None
+        need = min(self.pages_per_seq, self.arena.pages_for(total_tokens))
+        if not self.arena.allocator.reserve(need):
+            return None
+        lane = self._free_lanes.popleft()
+        self._pos[lane] = 0
+        self._base[lane] = 0
+        self._reserve_left[lane] = need
+        self._held[lane] = []
+        self._tables[lane, :] = self.arena.sentinel
+        return lane
+
+    def release_lane(self, lane: int) -> None:
+        """Retirement: pages back to the free list, unused reservation
+        returned, the lane reusable by the next admission."""
+        self.arena.allocator.free(self._held[lane])
+        if self._reserve_left[lane]:
+            self.arena.allocator.unreserve(int(self._reserve_left[lane]))
+        self._held[lane] = []
+        self._reserve_left[lane] = 0
+        self._tables[lane, :] = self.arena.sentinel
+        self._pos[lane] = 0
+        self._base[lane] = 0
+        self._free_lanes.append(lane)
+
+    def ensure_pages(self, lane: int, n_new: int) -> None:
+        """Pre-dispatch host bookkeeping: make the lane's view hold slots
+        for ``n_new`` tokens at positions ``pos .. pos+n_new-1`` —
+        recycling the oldest page (window eviction, ``base`` advances)
+        when the view is full, lazily drawing reserved pages as the
+        sequence grows."""
+        if n_new > self.window:
+            raise ValueError(f"chunk of {n_new} exceeds the "
+                             f"window ({self.window})")
+        pos, base = int(self._pos[lane]), int(self._base[lane])
+        ps = self.page_size
+        held = self._held[lane]
+        while pos + n_new - 1 - base >= self.window:
+            # sliding window at page granularity: the oldest page is
+            # recycled as the LAST LIVE table entry. Only the live
+            # prefix [0, len(held)) shifts — rotating the full row when
+            # the table still has sentinel holes would smear a hole into
+            # the middle and drop the chunk's writes. The recycled
+            # page's stale slots are either overwritten by this chunk
+            # or sit beyond the causal mask until they are.
+            oldest = held.pop(0)
+            held.append(oldest)
+            n = len(held)
+            self._tables[lane, :n - 1] = self._tables[lane, 1:n]
+            self._tables[lane, n - 1] = oldest
+            base += ps
+            self.arena.allocator.note_eviction()
+        last_idx = (pos + n_new - 1 - base) // ps
+        while len(held) <= last_idx:
+            page = self.arena.allocator.draw()
+            self._reserve_left[lane] -= 1
+            self._tables[lane, len(held)] = page
+            held.append(page)
+        self._base[lane] = base
+
+    def advance(self, lane: int, n: int) -> None:
+        """Account ``n`` tokens written by the dispatch that just ran."""
+        self._pos[lane] += int(n)
+
+    def rel_pos(self, lane: int) -> int:
+        """View-relative position of the lane's next token."""
+        return int(self._pos[lane] - self._base[lane])
+
+    # -- the jitted paged step ----------------------------------------
+
+    def run(self, ids: np.ndarray, write_slots: np.ndarray,
+            rel_pos: np.ndarray, tables: np.ndarray) -> np.ndarray:
+        """One paged forward over a COMPACT lane selection (``ids
+        [B, t_new]``, ``tables [B, P]`` — the scheduler packs only the
+        lanes that actually have work, bucketed to a power of two, so a
+        single admitting sequence does not pay a full-width prefill):
+        scatter the new tokens' K/V, gather, attend, return probs
+        ``[B, t_new, V]`` on host. Pools are donated and replaced, so
+        the arena costs one copy of HBM. Jitted once per
+        ``(B, t_new, P)`` bucket under a retrace guard — the bucket set
+        is fixed (≤ log₂(lanes)+1 sizes × two chunk lengths), so
+        steady-state decode never retraces."""
+        b, t_new = ids.shape
+        name = f"paged_decode[S{b}xT{t_new}xP{self.pages_per_seq}]"
+
+        def step(params, k_pools, v_pools, ids, tables, wslots, rel):
+            return _transformer.paged_decode_forward(
+                self.net, params, k_pools, v_pools, ids, tables, wslots,
+                rel)
+
+        fn = _xla.keyed_jit(
+            self._jit_cache, step, extra=name,
+            wrap=lambda f: _xla.retrace_guard(f, name, self.registry),
+            donate_argnums=(1, 2))
+        try:
+            probs, k_pools, v_pools = fn(
+                self.net.params, self.arena.k_pools, self.arena.v_pools,
+                ids, tables, write_slots, rel_pos)
+        except Exception:
+            # the pools were DONATED into the failed dispatch — on device
+            # backends they may already be consumed, so rebuild before
+            # re-raising (the scheduler retires the in-flight batch and
+            # keeps serving on the fresh arena)
+            self.arena.reset_pools()
+            raise
+        self.arena.k_pools = list(k_pools)
+        self.arena.v_pools = list(v_pools)
+        return np.asarray(probs)
+
+    def warmup(self) -> None:
+        """Compile the entire fixed trace set — every power-of-two lane
+        bucket × both chunk lengths — up front, so serving cold-start
+        pays compilation here instead of on the first live requests.
+        Warmup dispatches carry all-sentinel tables and dropped write
+        slots, so they cannot perturb the arena."""
+        b = 1
+        while True:
+            for t in (1, self.prefill_chunk):
+                self.run(np.zeros((b, t), np.int32),
+                         np.full((b, t), -1, np.int32),
+                         np.zeros(b, np.int32),
+                         np.full((b, self.pages_per_seq),
+                                 self.arena.sentinel, np.int32))
+            if b >= self.lanes:
+                break
+            b <<= 1           # same ladder _compact produces
+
+    # -- model swap (fenced by the scheduler) -------------------------
+
+    def swap_net(self, net) -> None:
+        """Replace the served model at a step boundary. The topology must
+        match (same vertices, same param shapes) — paged state is laid
+        out per attention vertex; a different graph would silently
+        mis-read it. Clears the trace cache (the old traces closed over
+        the old net object)."""
+        self._validate_net(net)
+        self._check_decode_config(net)
+        if list(net.topo_order) != list(self.net.topo_order):
+            raise ValueError("model swap with a different graph topology")
+        import jax
+        old_shapes = jax.tree_util.tree_map(lambda a: tuple(a.shape),
+                                            self.net.params)
+        new_shapes = jax.tree_util.tree_map(lambda a: tuple(a.shape),
+                                            net.params)
+        if old_shapes != new_shapes:
+            raise ValueError("model swap with different parameter shapes")
+        self.net = net
+        self._jit_cache.clear()
+        # recompile the trace ladder NOW, while the caller holds the
+        # fence — otherwise the first post-swap requests pay per-bucket
+        # compilation inside the decode loop with their deadlines burning
+        self.warmup()
+
+    def lanes_free(self) -> int:
+        return len(self._free_lanes)
+
+
+class DecodeScheduler:
+    """The continuous-batching loop (see module docstring).
+
+    Every tick: retire expired/finished sequences → admit from the
+    bounded queue against lanes + page reservations → ONE batched prefill
+    chunk for admitting sequences → ONE decode step for every decoding
+    sequence. ``step_once()`` is public so deterministic tests drive the
+    whole machine on a :class:`ManualClock` with no threads.
+    """
+
+    def __init__(self, engine: PagedDecodeEngine, *, max_queue: int = 64,
+                 default_max_new_tokens: int = 32,
+                 request_timeout_s: float = 30.0,
+                 clock: Clock = SYSTEM_CLOCK,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 tracer=None, start_thread: bool = True):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.request_timeout_s = float(request_timeout_s)
+        self.clock = clock
+        self.tracer = tracer
+        self.registry = registry if registry is not None else engine.registry
+        self._init_metrics()
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._active: Dict[int, _Sequence] = {}
+        # held across one full tick: the step boundary every outside
+        # mutation (drain bookkeeping, model swap) must fence on
+        self._dispatch_lock = threading.RLock()
+        self._draining = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        if start_thread:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _init_metrics(self) -> None:
+        reg = self.registry
+        # same family the wave path sheds into — one pane of glass
+        self._m_shed = reg.counter(
+            "serving_shed_total",
+            "Predict requests shed with 503 before reaching the model",
+            ("reason",))
+        self._m_admitted = reg.counter(
+            "decode_admitted_total",
+            "Generative sequences admitted into the decode batch")
+        self._m_retired = reg.counter(
+            "decode_retired_total",
+            "Generative sequences retired, by reason", ("reason",))
+        self._m_steps = reg.counter(
+            "decode_steps_total", "Batched decode steps dispatched")
+        self._m_tokens = reg.counter(
+            "decode_tokens_total",
+            "Tokens pushed through the paged decode path", ("phase",))
+        self._m_occupancy = reg.histogram(
+            "decode_batch_occupancy",
+            "Sequences active in each batched decode step",
+            buckets=[float(1 << i) for i in range(11)])
+        self._m_ttft = reg.histogram(
+            "decode_ttft_seconds",
+            "Submit → first generated token (queue + prefill)")
+        self._m_tpot = reg.histogram(
+            "decode_time_per_output_token_seconds",
+            "Steady-state seconds per output token, per finished sequence",
+            buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0])
+        # weakly bound, like the arena gauges: a retired scheduler (and
+        # through it the engine, params, and pools) must stay
+        # collectable even on a shared registry — a dead ref raises,
+        # dropping the series at exposition
+        ref = weakref.ref(self)
+
+        def _sample(get):
+            def fn():
+                sched = ref()
+                if sched is None:
+                    raise LookupError("scheduler retired")
+                return float(get(sched))
+            return fn
+
+        reg.gauge(
+            "decode_active_sequences",
+            "Generative sequences currently holding a decode lane"
+        ).set_function(_sample(lambda s: len(s._active)))
+        reg.gauge(
+            "decode_queue_depth",
+            "Generative requests accepted but not yet admitted"
+        ).set_function(_sample(lambda s: len(s._queue)))
+
+    # -- intake --------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None, *,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               seed: Optional[int] = None) -> DecodeRequest:
+        """Accept one generative request into the bounded queue. Raises
+        :class:`SchedulerDraining` / :class:`SchedulerSaturated` (the
+        shed paths — recorded by reason) instead of queueing unbounded
+        latency."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if self.engine.vocab and (prompt.min() < 0
+                                  or prompt.max() >= self.engine.vocab):
+            raise ValueError(
+                f"prompt ids outside [0, {self.engine.vocab})")
+        n_new = int(max_new_tokens if max_new_tokens is not None
+                    else self.default_max_new_tokens)
+        if n_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        rng = (np.random.default_rng(seed) if temperature > 0 else None)
+        req = DecodeRequest(
+            prompt, n_new, temperature, eos_id,
+            Deadline(timeout_s if timeout_s is not None
+                     else self.request_timeout_s, self.clock),
+            rng, self.clock.monotonic())
+        with self._cond:
+            # flags checked under the lock: a submit racing stop() must
+            # either land before the shutdown flush or be refused — never
+            # strand a request in a queue nothing will ever drain
+            if self._draining or self._stopped:
+                self._m_shed.inc(reason="draining")
+                raise SchedulerDraining("decode scheduler is draining")
+            if len(self._queue) >= self.max_queue:
+                self._m_shed.inc(reason="decode_queue_full")
+                raise SchedulerSaturated(
+                    "decode queue full", retry_after=1.0)
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req
+
+    # -- the continuous-batching tick ---------------------------------
+
+    def step_once(self) -> bool:
+        """One scheduler tick: retire → admit → prefill chunk → decode
+        step. Returns whether anything progressed. Dispatch errors retire
+        every in-flight sequence with ``finish_reason="error"`` and leave
+        the scheduler serving (the arena's masks make recycled pages
+        safe for the next admissions)."""
+        with self._dispatch_lock:
+            progressed = self._retire_expired()
+            progressed = self._admit() or progressed
+            try:
+                progressed = self._prefill_tick() or progressed
+                progressed = self._decode_tick() or progressed
+            except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
+                for seq in list(self._active.values()):
+                    seq.req.error = f"{type(e).__name__}: {e}"
+                    self._retire(seq, "error")
+                progressed = True
+            return progressed
+
+    def _retire_expired(self) -> bool:
+        any_ = False
+        for seq in list(self._active.values()):
+            if seq.req.deadline.expired:
+                self._retire(seq, "deadline")
+                any_ = True
+        with self._cond:
+            queued = list(self._queue)
+        for req in queued:
+            if req.deadline.expired:
+                with self._cond:
+                    try:
+                        self._queue.remove(req)
+                    except ValueError:
+                        continue
+                self._finish(req, "deadline")
+                self._m_retired.inc(reason="deadline")
+                any_ = True
+        return any_
+
+    def _admit(self) -> bool:
+        admitted = False
+        while True:
+            with self._cond:
+                if not self._queue:
+                    break
+                req = self._queue[0]
+            lane = self.engine.acquire_lane(
+                len(req.prompt) + req.max_new_tokens)
+            if lane is None:          # no lane / page pressure: stay queued
+                break
+            with self._cond:
+                self._queue.popleft()
+            self._active[lane] = _Sequence(req, lane)
+            self._m_admitted.inc()
+            admitted = True
+        return admitted
+
+    def _compact(self, seqs: List[_Sequence], t_new: int
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                            np.ndarray]:
+        """Pack the lanes that actually have work into a power-of-two
+        batch bucket: a lone admission prefills at [1, C] cost, not a
+        full-width padded dispatch, and the tail of a draining batch
+        decodes at [1..] cost — while the bucket SET stays fixed, so the
+        retrace pin holds."""
+        eng = self.engine
+        b = 1
+        while b < len(seqs):
+            b <<= 1
+        ids = np.zeros((b, t_new), np.int32)
+        wslots = np.full((b, t_new), -1, np.int32)
+        rel = np.zeros(b, np.int32)
+        tables = np.full((b, eng.pages_per_seq), eng.arena.sentinel,
+                         np.int32)
+        for i, seq in enumerate(seqs):
+            tables[i] = eng._tables[seq.lane]
+        return ids, wslots, rel, tables
+
+    def _prefill_tick(self) -> bool:
+        seqs = [s for s in self._active.values() if s.state == _PREFILL]
+        if not seqs:
+            return False
+        eng = self.engine
+        c = eng.prefill_chunk
+        chunk_len: List[int] = []
+        for seq in seqs:
+            n = min(c, len(seq.req.prompt) - seq.cursor)
+            eng.ensure_pages(seq.lane, n)
+            chunk_len.append(n)
+        ids, wslots, rel, tables = self._compact(seqs, c)
+        for i, seq in enumerate(seqs):
+            n = chunk_len[i]
+            r = eng.rel_pos(seq.lane)
+            ids[i, :n] = seq.req.prompt[seq.cursor:seq.cursor + n]
+            wslots[i, :n] = r + np.arange(n)
+            rel[i] = r
+        _faults.check("serving.decode_step",
+                      {"phase": "prefill", "lanes": len(seqs)})
+        probs = eng.run(ids, wslots, rel, tables)   # [B, C, V]
+        self._m_tokens.inc(sum(chunk_len), phase="prefill")
+        for i, seq in enumerate(seqs):
+            n = chunk_len[i]
+            eng.advance(seq.lane, n)
+            seq.cursor += n
+            if seq.cursor == len(seq.req.prompt):
+                # the last prompt position's distribution yields the
+                # FIRST generated token (TTFT lands here)
+                self._emit_token(seq, probs[i, n - 1])
+                if seq.lane in self._active:
+                    seq.state = _DECODE
+        return True
+
+    def _decode_tick(self) -> bool:
+        seqs = [s for s in self._active.values() if s.state == _DECODE]
+        if not seqs:
+            return False
+        eng = self.engine
+        for seq in seqs:
+            eng.ensure_pages(seq.lane, 1)
+        ids, wslots, rel, tables = self._compact(seqs, 1)
+        for i, seq in enumerate(seqs):
+            r = eng.rel_pos(seq.lane)
+            ids[i, 0] = seq.last_token
+            wslots[i, 0] = r
+            rel[i] = r
+        _faults.check("serving.decode_step",
+                      {"phase": "decode", "lanes": len(seqs)})
+        probs = eng.run(ids, wslots, rel, tables)   # [B, 1, V]
+        self._m_steps.inc()
+        self._m_occupancy.observe(float(len(seqs)))
+        self._m_tokens.inc(len(seqs), phase="decode")
+        # bulk greedy argmax: one vectorized pass instead of a per-lane
+        # python round-trip — this loop runs once per generated token
+        # across the whole batch (identical result: argmax is invariant
+        # under sample_token's monotone float64 cast)
+        greedy = np.argmax(probs[:, 0, :], axis=-1)
+        for i, seq in enumerate(seqs):
+            eng.advance(seq.lane, 1)
+            self._emit_token(seq, probs[i, 0],
+                             greedy_tok=int(greedy[i]))
+        return True
+
+    def _emit_token(self, seq: _Sequence, probs: np.ndarray, *,
+                    greedy_tok: Optional[int] = None) -> None:
+        req = seq.req
+        tok = (greedy_tok if greedy_tok is not None
+               and req.temperature <= 0.0
+               else _transformer.sample_token(probs, req.temperature,
+                                              req.rng))
+        if req.t_first_token is None:
+            req.t_first_token = self.clock.monotonic()
+            self._m_ttft.observe(req.t_first_token - req.t_submit)
+        req.tokens.append(tok)
+        seq.last_token = tok
+        if req.eos_id is not None and tok == req.eos_id:
+            self._retire(seq, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._retire(seq, "max_tokens")
+
+    def _retire(self, seq: _Sequence, reason: str) -> None:
+        self.engine.release_lane(seq.lane)
+        self._active.pop(seq.lane, None)
+        self._finish(seq.req, reason)
+        self._m_retired.inc(reason=reason)
+
+    def _finish(self, req: DecodeRequest, reason: str) -> None:
+        req.finish_reason = reason
+        req.t_done = self.clock.monotonic()
+        if req.t_first_token is not None and len(req.tokens) > 1:
+            self._m_tpot.observe(
+                (req.t_done - req.t_first_token)
+                / (len(req.tokens) - 1))
+        req.event.set()
+
+    # -- loop / lifecycle ---------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stopped:
+            progressed = self.step_once()
+            if progressed:
+                continue
+            with self._cond:
+                if self._stopped:
+                    break
+                if not self._queue and not self._active:
+                    self._cond.wait(timeout=0.05)
+                else:
+                    # queued work that could not admit yet (page/lane
+                    # pressure resolves at the next retirement)
+                    self._cond.wait(timeout=0.002)
+
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @contextlib.contextmanager
+    def fence(self):
+        """Hold the scheduler at a step boundary (no dispatch in flight)
+        and yield the number of in-flight sequences — the gate a model
+        swap must pass through."""
+        with self._dispatch_lock:
+            yield len(self._active)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Decode-aware drain: stop ACCEPTING, keep SCHEDULING — every
+        already-accepted request (queued or in flight) finishes, errors,
+        or hits its own deadline before the drain reports clean. True if
+        fully drained within ``timeout``. Threadless schedulers (tests)
+        are stepped inline."""
+        self._draining = True
+        end = self.clock.monotonic() + timeout
+        while self.clock.monotonic() < end:
+            if not self._queue and not self._active:
+                return True
+            if self._thread is None:
+                if not self.step_once():
+                    self.clock.sleep(0.001)
+            else:
+                time.sleep(0.002)
+        return not self._queue and not self._active
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the loop; anything still queued or in flight finishes
+        with ``finish_reason="shutdown"``. If the loop thread is wedged
+        inside a hung dispatch (it holds the dispatch lock for the whole
+        tick), the lock acquire below times out too and the stranded
+        requests are still answered — engine bookkeeping is skipped in
+        that case (the process is going down; waiters must not hang with
+        it)."""
+        self._draining = True
+        self._stopped = True
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        fenced = self._dispatch_lock.acquire(timeout=max(0.1, timeout))
+        try:
+            for seq in list(self._active.values()):
+                if fenced:
+                    self.engine.release_lane(seq.lane)
+                self._active.pop(seq.lane, None)
+                self._finish(seq.req, "shutdown")
+                self._m_retired.inc(reason="shutdown")
+            with self._cond:
+                queued, self._queue = list(self._queue), deque()
+            for req in queued:
+                self._finish(req, "shutdown")
+                self._m_retired.inc(reason="shutdown")
+        finally:
+            if fenced:
+                self._dispatch_lock.release()

@@ -1,0 +1,192 @@
+"""Paged KV-cache arena: preallocated block pools + a page allocator.
+
+The serving-side memory manager behind continuous-batching decode
+(PAPERS: vLLM/SOSP'23). Instead of a monolithic ``[b, max_t, f]`` cache
+per sequence — whose worst-case length must be reserved up front and
+whose slots idle whenever a sequence is shorter — K/V live in per-layer
+``[num_pages, page_size, heads, head_dim]`` block pools shared by every
+in-flight sequence. Each sequence owns an ordered page table of physical
+page ids; pages are handed out lazily as decode advances and returned to
+the free list the moment the sequence retires, so HBM holds exactly the
+tokens that exist, not the tokens that might.
+
+Two-level accounting:
+
+- **reservation** (admission control): a sequence reserves its worst-case
+  page count when admitted — ``ceil((prompt + max_new_tokens) /
+  page_size)`` capped at ``pages_per_seq`` — so a RUNNING sequence can
+  never deadlock waiting for a page another running sequence holds.
+  Reservations are counts, not physical pages.
+- **draw** (lazy allocation): physical pages leave the free list one at a
+  time, against the reservation, as the sequence actually grows.
+
+Sliding-window overflow is PAGE EVICTION: once a sequence holds
+``pages_per_seq`` pages, its oldest page is recycled as the new tail
+(the page table rotates, the view base advances by ``page_size``) —
+the decode-arena analog of the dense cache's per-token eviction in
+``SelfAttentionLayer._apply_streaming``, accounted in
+``kv_pages_evicted_total``.
+
+Thread-safety: the allocator locks itself (submit threads reserve while
+the decode loop draws); the pools are owned by the decode engine, which
+mutates them only under the scheduler's dispatch lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..util import metrics as _metrics
+
+__all__ = ["PageAllocator", "PagedKVArena"]
+
+
+class PageAllocator:
+    """Free-list allocator over ``num_pages`` physical pages with
+    reservation accounting (see module docstring)."""
+
+    def __init__(self, num_pages: int,
+                 registry: Optional[_metrics.MetricsRegistry] = None):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free = deque(range(self.num_pages))
+        self._reserved = 0
+        self._lock = threading.Lock()
+        reg = registry if registry is not None else _metrics.REGISTRY
+        self._m_evicted = reg.counter(
+            "kv_pages_evicted_total",
+            "KV pages recycled by sliding-window eviction")
+        # weakly bound callbacks: on a SHARED registry the newest arena's
+        # gauges win (per-server registries are the default, as with the
+        # serving gauges), and a retired allocator is collectable — a
+        # dead ref raises, which drops the series at exposition
+        ref = weakref.ref(self)
+
+        def _sample(attr):
+            def fn():
+                alloc = ref()
+                if alloc is None:
+                    raise LookupError("allocator retired")
+                return float(getattr(alloc, attr))
+            return fn
+
+        reg.gauge(
+            "kv_pages_in_use",
+            "KV arena pages currently owned by live sequences"
+        ).set_function(_sample("pages_in_use"))
+        reg.gauge(
+            "kv_pages_reserved",
+            "KV arena pages reserved by admitted sequences but not yet "
+            "drawn").set_function(_sample("reserved"))
+
+    @property
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    @property
+    def reserved(self) -> int:
+        with self._lock:
+            return self._reserved
+
+    def available(self) -> int:
+        """Pages an admission could still reserve."""
+        with self._lock:
+            return len(self._free) - self._reserved
+
+    def reserve(self, n: int) -> bool:
+        """Reserve ``n`` pages for a sequence about to be admitted.
+        False (and no state change) when the arena cannot guarantee
+        them."""
+        with self._lock:
+            if n > len(self._free) - self._reserved:
+                return False
+            self._reserved += n
+            return True
+
+    def unreserve(self, n: int) -> None:
+        """Return ``n`` unused reservations (early retirement: EOS before
+        max_new_tokens, or a capped window that never grew that far)."""
+        with self._lock:
+            if n > self._reserved:
+                raise ValueError(
+                    f"unreserve({n}) exceeds outstanding reservation "
+                    f"{self._reserved}")
+            self._reserved -= n
+
+    def draw(self) -> int:
+        """Hand out one physical page against an existing reservation."""
+        with self._lock:
+            if self._reserved < 1:
+                raise RuntimeError(
+                    "draw() without a reservation — admission control "
+                    "must reserve before the sequence grows")
+            # the reservation invariant (reserved <= free) makes this pop
+            # infallible
+            self._reserved -= 1
+            return self._free.popleft()
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Return physical pages to the free list (sequence retired)."""
+        with self._lock:
+            for p in pages:
+                if not (0 <= p < self.num_pages):
+                    raise ValueError(f"free() of unknown page {p}")
+                self._free.append(p)
+
+    def note_eviction(self, n: int = 1) -> None:
+        self._m_evicted.inc(n)
+
+
+class PagedKVArena:
+    """Per-attention-layer K/V block pools + the shared allocator.
+
+    ``layer_dims`` maps attention vertex name → ``(heads, head_dim)`` in
+    the order the decode walker visits them. ``SENTINEL`` (= num_pages,
+    one past the pool) marks page-table holes: gathers fill zeros there,
+    scatters drop.
+    """
+
+    def __init__(self, layer_dims: Dict[str, Tuple[int, int]], *,
+                 num_pages: int, page_size: int, dtype=jnp.float32,
+                 registry: Optional[_metrics.MetricsRegistry] = None):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if not layer_dims:
+            raise ValueError("arena needs at least one attention layer")
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.sentinel = self.num_pages
+        self.dtype = dtype
+        self.layer_names = list(layer_dims)
+        self._layer_dims = dict(layer_dims)
+        self.k_pools: List[jnp.ndarray] = []
+        self.v_pools: List[jnp.ndarray] = []
+        self.reset_pools()
+        self.allocator = PageAllocator(num_pages, registry=registry)
+
+    def reset_pools(self) -> None:
+        """Fresh zero pools. Used at construction AND after a failed
+        dispatch: the engine donates the pools into every step, so an
+        error mid-dispatch may have consumed the old buffers — rebuilding
+        is the only safe recovery (retiring sequences freed the pages;
+        zeros are indistinguishable from a fresh arena)."""
+        self.k_pools = []
+        self.v_pools = []
+        for h, d in self._layer_dims.values():
+            shape = (self.num_pages, self.page_size, h, d)
+            self.k_pools.append(jnp.zeros(shape, self.dtype))
+            self.v_pools.append(jnp.zeros(shape, self.dtype))
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` (ceil)."""
+        return -(-int(n_tokens) // self.page_size)
+
+    def nbytes(self) -> int:
+        return sum(int(p.nbytes) for p in self.k_pools + self.v_pools)

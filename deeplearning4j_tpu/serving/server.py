@@ -1,13 +1,22 @@
-"""HTTP inference server with micro-batching.
+"""HTTP inference server with micro-batching + continuous-batched decode.
 
 Endpoints:
   POST /predict   {"inputs": [[...], ...]} → {"outputs": [[...], ...]}
+  POST /generate  {"prompt_ids": [...], "max_new_tokens": N,
+                   "temperature": T, "eos_id": id, "timeout_s": s}
+                  → {"tokens": [...], "finish_reason": "eos|max_tokens|
+                     deadline", "ttft_ms": ..., "n_generated": N}
+                  (requires ``decode=`` — the continuous-batching
+                  scheduler over the paged KV arena, serving/decode.py)
   GET  /healthz   {"ok": true, "model": "...", "served": N,
                    "queue_depth": n, "queue_capacity": n,
-                   "breaker": "closed|open|half_open", "draining": bool}
+                   "breaker": "closed|open|half_open", "draining": bool,
+                   "decode": {"active": n, "queued": n} when enabled}
   GET  /metrics   Prometheus text exposition of this server's registry
   POST /model     swap the served model from a checkpoint zip path
-                  {"path": "/path/to/model.zip"}
+                  {"path": "/path/to/model.zip"} — refused (409) while
+                  generative sequences are in flight; fenced to a decode
+                  step boundary otherwise
 
 Design: requests land in a queue; a batcher thread coalesces up to
 ``max_batch`` examples (waiting at most ``batch_timeout_ms`` after the
@@ -66,6 +75,11 @@ from ..util.resilience import (SYSTEM_CLOCK, STATE_VALUES, CircuitBreaker,
                                Clock, Deadline)
 
 
+class ModelSwapRefused(RuntimeError):
+    """set_model refused because generative sequences are in flight —
+    retriable after drain (HTTP 409 on the /model endpoint)."""
+
+
 class _Pending:
     __slots__ = ("x", "event", "result", "error", "code", "deadline",
                  "enqueued_at", "span", "queue_span")
@@ -93,7 +107,7 @@ class InferenceServer:
                  breaker: Optional[CircuitBreaker] = None,
                  clock: Clock = SYSTEM_CLOCK,
                  registry: Optional[_metrics.MetricsRegistry] = None,
-                 tracer=None):
+                 tracer=None, decode=None):
         self._model = model
         self.max_batch = int(max_batch)
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
@@ -111,6 +125,29 @@ class InferenceServer:
             failure_threshold=3, reset_timeout_s=5.0, clock=clock,
             name="serving-model")
         self._chain_breaker_hook()
+        # continuous-batched generative decode (serving/decode.py):
+        # pass a prebuilt DecodeScheduler, or a dict of engine/scheduler
+        # kwargs to build one over THIS model and THIS registry
+        self.decode = None
+        if decode is not None:
+            from .decode import DecodeScheduler, PagedDecodeEngine
+            if isinstance(decode, DecodeScheduler):
+                self.decode = decode
+            else:
+                cfg = dict(decode)
+                sched_kw = {k: cfg.pop(k) for k in
+                            ("max_queue", "default_max_new_tokens",
+                             "request_timeout_s", "start_thread")
+                            if k in cfg}
+                engine = PagedDecodeEngine(model, registry=self.registry,
+                                           **cfg)
+                # compile the whole bucket ladder before the loop starts:
+                # server START pays it, not the first live requests'
+                # SLO deadlines
+                engine.warmup()
+                self.decode = DecodeScheduler(
+                    engine, clock=clock, registry=self.registry,
+                    tracer=tracer, **sched_kw)
         self._queue: "queue.Queue[_Pending]" = queue.Queue(
             maxsize=int(max_queue))
         self._lock = threading.Lock()
@@ -173,10 +210,20 @@ class InferenceServer:
                         self._json({"error": err}, code, headers)
                     else:
                         self._json({"outputs": out.tolist()})
+                elif self.path == "/generate":
+                    body, code, retry_after = outer._generate(payload)
+                    headers = ({"Retry-After": f"{retry_after:.0f}"}
+                               if retry_after is not None else None)
+                    self._json(body, code, headers)
                 elif self.path == "/model":
                     try:
                         outer.swap_model_from(payload["path"])
                         self._json({"ok": True})
+                    except ModelSwapRefused as e:
+                        # retriable conflict, not a bad request: drain
+                        # the in-flight decodes and POST again
+                        self._json({"error": str(e)}, 409,
+                                   {"Retry-After": "1"})
                     except Exception as e:
                         self._json({"error": str(e)}, 400)
                 else:
@@ -261,15 +308,74 @@ class InferenceServer:
     # ------------------------------------------------------------------
 
     def _health(self) -> dict:
-        return {"ok": not self._draining
-                      and self.breaker.state != "open",
-                "model": type(self._model).__name__,
-                "served": self.served,
-                "shed": self.shed,
-                "queue_depth": self._queue.qsize(),
-                "queue_capacity": self._queue.maxsize,
-                "breaker": self.breaker.state,
-                "draining": self._draining}
+        h = {"ok": not self._draining
+                   and self.breaker.state != "open",
+             "model": type(self._model).__name__,
+             "served": self.served,
+             "shed": self.shed,
+             "queue_depth": self._queue.qsize(),
+             "queue_capacity": self._queue.maxsize,
+             "breaker": self.breaker.state,
+             "draining": self._draining}
+        if self.decode is not None:
+            h["decode"] = {"active": self.decode.active_count(),
+                           "queued": self.decode.queue_depth()}
+        return h
+
+    def _generate(self, payload: dict
+                  ) -> Tuple[dict, int, Optional[float]]:
+        """POST /generate → (body, http_code, retry_after_s). Blocks the
+        handler thread until the scheduler finishes the request (the
+        continuous-batching loop runs it concurrently with every other
+        in-flight sequence)."""
+        from .decode import SchedulerDraining, SchedulerSaturated
+        if self.decode is None:
+            return ({"error": "generative decode not enabled on this "
+                              "server (pass decode=)"}, 400, None)
+        try:
+            prompt = payload["prompt_ids"]
+        except KeyError:
+            return {"error": "missing prompt_ids"}, 400, None
+        try:
+            # coerce up front: a numeric STRING would pass Deadline's
+            # float() inside submit and then blow up in the wait
+            # arithmetic below with no HTTP response at all
+            timeout_s = (None if payload.get("timeout_s") is None
+                         else float(payload["timeout_s"]))
+        except (TypeError, ValueError) as e:
+            return {"error": f"bad timeout_s: {e}"}, 400, None
+        try:
+            req = self.decode.submit(
+                prompt, payload.get("max_new_tokens"),
+                temperature=float(payload.get("temperature", 0.0)),
+                eos_id=payload.get("eos_id"),
+                timeout_s=timeout_s,
+                seed=payload.get("seed"))
+        except SchedulerDraining:
+            return {"error": "server is draining"}, 503, 1.0
+        except SchedulerSaturated as e:
+            return ({"error": "server overloaded (decode queue full)"},
+                    503, e.retry_after)
+        except (ValueError, TypeError) as e:
+            return {"error": f"bad request: {e}"}, 400, None
+        budget = (timeout_s if timeout_s is not None
+                  else self.decode.request_timeout_s)
+        req.wait(timeout=budget + 5.0)
+        if req.finish_reason is None:          # scheduler wedged — honest 504
+            return {"error": "generation timeout"}, 504, None
+        if req.finish_reason == "error":
+            return ({"error": req.error or "decode failed"}, 500, None)
+        if req.finish_reason == "shutdown":
+            return {"error": "server shutting down"}, 503, None
+        if req.finish_reason == "deadline" and not req.tokens:
+            return {"error": "request deadline exceeded"}, 504, None
+        body = {"tokens": [int(t) for t in req.tokens],
+                "finish_reason": req.finish_reason,
+                "n_generated": len(req.tokens)}
+        if req.t_first_token is not None:
+            body["ttft_ms"] = round(
+                1000.0 * (req.t_first_token - req.t_submit), 3)
+        return body, 200, None
 
     def _predict(self, x: np.ndarray
                  ) -> Tuple[Optional[np.ndarray], Optional[str],
@@ -438,7 +544,22 @@ class InferenceServer:
     # ------------------------------------------------------------------
 
     def set_model(self, model) -> None:
-        """Hot-swap the served model (atomic w.r.t. in-flight batches)."""
+        """Hot-swap the served model (atomic w.r.t. in-flight batches).
+
+        With generative decode enabled the swap is FENCED to a decode
+        step boundary and REFUSED while sequences are in flight: a
+        mid-decode swap would mis-read every live K/V page (the cache
+        holds the old model's activations). Drain first."""
+        if self.decode is not None:
+            with self.decode.fence() as in_flight:
+                if in_flight:
+                    raise ModelSwapRefused(
+                        f"refusing model swap: {in_flight} generative "
+                        "sequence(s) in flight — drain() first")
+                self.decode.engine.swap_net(model)
+                with self._lock:
+                    self._model = model
+            return
         with self._lock:
             self._model = model
 
@@ -448,24 +569,31 @@ class InferenceServer:
         self.set_model(load_model(path))
 
     def drain(self, timeout: float = 30.0) -> bool:
-        """Stop admitting new predicts (they answer 503) and wait until
-        everything already queued has been answered. True if fully
+        """Stop admitting new work (predicts AND generates answer 503)
+        and wait until everything already accepted has been answered —
+        including in-flight generative sequences, which keep decoding
+        until they finish or hit their own SLO deadline. True if fully
         drained within ``timeout``."""
         self._draining = True
         deadline = time.perf_counter() + timeout
+        ok = True
+        if self.decode is not None:
+            ok = self.decode.drain(timeout=timeout)
         while time.perf_counter() < deadline:
             with self._pending_lock:
                 if self._pending == 0:
-                    return True
+                    return ok
             time.sleep(0.005)
         with self._pending_lock:
-            return self._pending == 0
+            return ok and self._pending == 0
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Graceful shutdown: by default drains queued requests first so a
         planned restart drops nothing mid-flight."""
         if drain:
             self.drain(timeout)
+        if self.decode is not None:
+            self.decode.stop()
         self._stop.set()
         # answer anything still queued (drain=False or drain timeout)
         while True:

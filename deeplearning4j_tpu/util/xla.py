@@ -77,17 +77,27 @@ def trace_env_key() -> str:
             f"|fabwd={os.environ.get('DL4JTPU_FLASH_BWD', 'pallas')}")
 
 
-def keyed_jit(cache: Dict[str, Any], fn: Callable, **jit_kw):
+def keyed_jit(cache: Dict[str, Any], fn: Callable, *, extra: str = "",
+              wrap: Optional[Callable[[Callable], Callable]] = None,
+              **jit_kw):
     """ONE copy of the trace-env-keyed jit-cache lookup the sharded
     trainers use: returns the jit of ``fn`` cached under the CURRENT
     :func:`trace_env_key`, compiling a fresh one when a routing flag has
     flipped since the cached trace (the trainer-side analog of the net
-    runtimes' ``_jit_cache`` key suffix)."""
+    runtimes' ``_jit_cache`` key suffix).
+
+    ``extra`` extends the key for callers that maintain several traces per
+    flag state (e.g. the decode engine's per-bucket step functions);
+    ``wrap`` post-processes a freshly built jit exactly once (e.g.
+    :func:`retrace_guard`), so the wrapper's own state survives cache
+    hits."""
     import jax
-    key = trace_env_key()
+    key = trace_env_key() + (f"|{extra}" if extra else "")
     jitted = cache.get(key)
     if jitted is None:
         jitted = jax.jit(fn, **jit_kw)
+        if wrap is not None:
+            jitted = wrap(jitted)
         cache[key] = jitted
     return jitted
 

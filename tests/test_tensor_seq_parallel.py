@@ -336,10 +336,12 @@ class TestStreamingAttentionDecode:
                                        atol=1e-5)
 
     def test_cache_overflow_warns_instead_of_silent_clamp(self, rng):
-        """Feeding more TOTAL steps than max_cache_t overwrites the cache
-        tail and desyncs global positions — the host-side counter must
-        surface that (once) instead of degrading silently (ADVICE r5
-        low); clearing the state resets the tally."""
+        """Feeding more TOTAL steps than max_cache_t slides the window
+        (the oldest positions are evicted — see
+        tests/test_decode.py::TestStreamingEviction for the semantics) —
+        the host-side counter must surface the transition (once) instead
+        of degrading silently (ADVICE r5 low); clearing the state resets
+        the tally."""
         import warnings as _warnings
         net = self._mln(max_cache_t=4)
         x = rng.normal(size=(2, 3, 8)).astype(np.float32)
