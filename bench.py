@@ -58,12 +58,47 @@ def _run_config(out: dict, name: str, fn) -> dict | None:
 
 
 def _peak_flops_per_sec() -> float:
-    """Per-chip peak (bf16) — single source of truth in util/profiling.py."""
+    """Per-chip peak (bf16) — single source of truth in util/profiling.py.
+    Unknown device kinds (CPU harness) return None there; the bench's MFU
+    columns then assume this harness's chip so the ratio trajectory stays
+    comparable across rounds."""
     from deeplearning4j_tpu.util import profiling
-    try:
-        return profiling.peak_flops_per_sec()
-    except ValueError:
-        return 197e12  # unknown kind: assume v5e (this harness's chip)
+    peak = profiling.peak_flops_per_sec()
+    return peak if peak is not None else 197e12  # assume v5e
+
+
+MFU_DEVIATION_WARN_PCT = 15.0
+
+
+def _mfu_crosscheck(fn_name: str, analytic_flops: float) -> dict:
+    """Measured-vs-analytic FLOPs cross-check for one benched program:
+    compares the compiled executable's HLO cost-analysis FLOPs
+    (``compiled_flops{fn}``, recorded by the retrace guard at compile
+    time) against the analytic formula's per-dispatch FLOPs. A deviation
+    beyond ``MFU_DEVIATION_WARN_PCT`` means the analytic formula (the MFU
+    numerator every PERF.md claim uses) has drifted from what the
+    compiler actually builds — flagged in the payload AND logged, so
+    formula rot is caught mechanically."""
+    from deeplearning4j_tpu.util import metrics as _metrics
+    out = {"analytic_flops_per_dispatch": analytic_flops}
+    g = _metrics.REGISTRY.get("compiled_flops")
+    measured = g.value(fn=fn_name) if g is not None else 0.0
+    if not measured:
+        out["flops_crosscheck"] = "unavailable"
+        return out
+    dev_pct = 100.0 * (measured - analytic_flops) / analytic_flops
+    out.update({
+        "compiled_flops_per_dispatch": measured,
+        "flops_deviation_pct": round(dev_pct, 2),
+        "flops_deviation_exceeds_warn": abs(dev_pct) > MFU_DEVIATION_WARN_PCT,
+    })
+    if abs(dev_pct) > MFU_DEVIATION_WARN_PCT:
+        print(f"WARNING: {fn_name} measured FLOPs deviate "
+              f"{dev_pct:+.1f}% from the analytic formula "
+              f"(>{MFU_DEVIATION_WARN_PCT:.0f}%) — the MFU numerator has "
+              "drifted; re-derive the formula against the compiled "
+              "program", flush=True)
+    return out
 
 
 def _conv_flops_nhwc(h, w, c_in, c_out, kh, kw, stride):
@@ -157,8 +192,12 @@ def bench_lenet() -> dict:
     steps = rounds * k
     eps = steps * batch / dt
     mfu = eps * _lenet_train_flops_per_example() / _peak_flops_per_sec()
-    return {"examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
-            "step_ms": round(1000 * dt / steps, 3), "batch": batch}
+    out = {"examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
+           "step_ms": round(1000 * dt / steps, 3), "batch": batch}
+    out.update(_mfu_crosscheck(
+        "MultiLayerNetwork.train_repeat",
+        _lenet_train_flops_per_example() * batch * k))
+    return out
 
 
 def _make_resnet():
@@ -198,9 +237,13 @@ def bench_resnet50() -> dict:
     eps = steps * batch / dt
     mfu = (eps * _resnet50_train_flops_per_example(image)
            / _peak_flops_per_sec())
-    return {"examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
-            "step_ms": round(1000 * dt / steps, 3), "batch": batch,
-            "image": image}
+    out = {"examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
+           "step_ms": round(1000 * dt / steps, 3), "batch": batch,
+           "image": image}
+    out.update(_mfu_crosscheck(
+        "ComputationGraph.train_repeat",
+        _resnet50_train_flops_per_example(image) * batch * k))
+    return out
 
 
 def bench_resnet50_pipeline() -> dict:
@@ -445,10 +488,15 @@ def bench_lstm() -> dict:
     tokens = eps * t_len
     mfu = (eps * _lstm_train_flops_per_example(vocab, hidden, layers, t_len)
            / _peak_flops_per_sec())
-    return {"tokens_per_sec": round(tokens, 1),
-            "examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
-            "step_ms": round(1000 * dt / steps, 3), "batch": batch,
-            "seq_len": t_len, "hidden": hidden, "vocab": vocab}
+    out = {"tokens_per_sec": round(tokens, 1),
+           "examples_per_sec": round(eps, 1), "mfu": round(mfu, 4),
+           "step_ms": round(1000 * dt / steps, 3), "batch": batch,
+           "seq_len": t_len, "hidden": hidden, "vocab": vocab}
+    out.update(_mfu_crosscheck(
+        "MultiLayerNetwork.train_repeat",
+        _lstm_train_flops_per_example(vocab, hidden, layers, t_len)
+        * batch * k))
+    return out
 
 
 def bench_word2vec() -> dict:
@@ -621,14 +669,23 @@ def bench_transformer_lm() -> dict:
     tokens_per_sec = b * T / step_s
     fpt = _transformer_train_flops_per_token(d_model, n_layers, d_ff, V, T)
     mfu = tokens_per_sec * fpt / _peak_flops_per_sec()
-    return {"step_ms": round(step_s * 1e3, 2),
-            "tokens_per_sec": round(tokens_per_sec, 1),
-            "mfu": round(mfu, 4),
-            "model_flops_per_token": round(fpt, 1),
-            "batch": b, "seq_len": T, "d_model": d_model,
-            "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
-            "vocab": V, "input_mode": "ids", "dtype": "mixed_bf16",
-            "attention": "pallas_flash"}
+    out = {"step_ms": round(step_s * 1e3, 2),
+           "tokens_per_sec": round(tokens_per_sec, 1),
+           "mfu": round(mfu, 4),
+           "model_flops_per_token": round(fpt, 1),
+           "batch": b, "seq_len": T, "d_model": d_model,
+           "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
+           "vocab": V, "input_mode": "ids", "dtype": "mixed_bf16",
+           "attention": "pallas_flash"}
+    out.update(_mfu_crosscheck("ComputationGraph.train_repeat",
+                               fpt * b * T * k))
+    # the measured-MFU column: same step timing, but the NUMERATOR is the
+    # compiled program's cost-analysis FLOPs instead of the formula
+    if "compiled_flops_per_dispatch" in out:
+        out["measured_mfu"] = round(
+            out["compiled_flops_per_dispatch"] / (b * T * k)
+            * tokens_per_sec / _peak_flops_per_sec(), 4)
+    return out
 
 
 def bench_decode() -> dict:
@@ -818,6 +875,32 @@ def main() -> None:
             out["metrics"] = snap
     except Exception:
         pass    # metrics must never erase a round's evidence
+
+    # compile-cost summary + measured-vs-analytic verdict: the compile
+    # histogram details ride out["metrics"]["xla_compile_seconds"]; here
+    # is the one-line version a human (or the round driver) reads first
+    try:
+        from deeplearning4j_tpu.util import metrics as _metrics
+        hist = _metrics.REGISTRY.get("xla_compile_seconds")
+        if hist is not None:
+            series = hist.snapshot()["series"]
+            out["xla_compile_summary"] = {
+                "compiles": int(sum(s["count"] for s in series)),
+                "total_seconds": round(sum(s["sum"] for s in series), 2),
+            }
+        deviations = {
+            name: res["flops_deviation_pct"]
+            for name, res in out.items()
+            if isinstance(res, dict) and "flops_deviation_pct" in res}
+        if deviations:
+            worst = max(deviations.values(), key=abs)
+            out["mfu_crosscheck"] = {
+                "deviation_pct_by_config": deviations,
+                "worst_deviation_pct": worst,
+                "exceeds_warn": abs(worst) > MFU_DEVIATION_WARN_PCT,
+            }
+    except Exception:
+        pass
 
     # decode-serving row: sustained continuous-batched tokens/s under
     # Poisson load; vs_baseline is the A/B ratio over the wave-batched

@@ -300,6 +300,7 @@ class ComputationGraph:
                                         train=train,
                                         rng=rng if train else None)
                 return [acts[n] for n in self.conf.network_outputs]
+            fn = _xla.retrace_guard(fn, "ComputationGraph.output")
             self._jit_cache[cache_key] = fn
         rng = (_rng.fold_name(_rng.key(self.training.seed),
                               f"output_{self.iteration_count}")
@@ -339,6 +340,7 @@ class ComputationGraph:
                                 if k in ("h", "c")}
                          for name, st in new_states.items()}
                 return [acts[n] for n in self.conf.network_outputs], carry
+            fn = _xla.retrace_guard(fn, "ComputationGraph.rnn_time_step")
             self._jit_cache[cache_key] = fn
         outs, self._rnn_state = fn(self.params,
                                    self._states_map(self._rnn_state), inputs)

@@ -256,6 +256,7 @@ class MultiLayerNetwork:
                 out, _ = self._forward(params, states, x, train=train,
                                        rng=rng if train else None)
                 return out
+            fn = _xla.retrace_guard(fn, "MultiLayerNetwork.output")
             self._jit_cache[cache_key] = fn
         rng = _rng.fold_name(_rng.key(self.training.seed),
                              f"output_{self.iteration_count}") if train else None
@@ -297,6 +298,7 @@ class MultiLayerNetwork:
                 out, new_states = self._forward(params, states, x,
                                                 train=False)
                 return out, self._extract_rnn_carry(new_states)
+            fn = _xla.retrace_guard(fn, "MultiLayerNetwork.rnn_time_step")
             self._jit_cache[cache_key] = fn
         out, self._rnn_state = fn(self.params,
                                   self._states_list(self._rnn_state), x)

@@ -159,11 +159,13 @@ class ShardedDSLTrainerBase:
     def _step(self, *args):
         from ..util import xla as _xla
         return _xla.keyed_jit(self._step_fns, self._step_fn,
+                              name=f"{type(self).__name__}.step",
                               donate_argnums=(0, 1))(*args)
 
     def _fwd(self, *args):
         from ..util import xla as _xla
-        return _xla.keyed_jit(self._fwd_fns, self._fwd_fn)(*args)
+        return _xla.keyed_jit(self._fwd_fns, self._fwd_fn,
+                              name=f"{type(self).__name__}.forward")(*args)
 
     def _stage(self, a):
         a = jnp.asarray(a)

@@ -122,12 +122,14 @@ class SequenceParallelTrainer:
 
     def forward(self, x):
         from ..util import xla as _xla
-        fwd = _xla.keyed_jit(self._forward_fns, self._forward_fn)
+        fwd = _xla.keyed_jit(self._forward_fns, self._forward_fn,
+                             name=f"{type(self).__name__}.forward")
         return fwd(self.params, self._stage(x))
 
     def fit_batch(self, x, y) -> jax.Array:
         from ..util import xla as _xla
         step = _xla.keyed_jit(self._step_fns, self._step_fn,
+                              name=f"{type(self).__name__}.step",
                               donate_argnums=(0,))
         self.params, loss = step(self.params, self._stage(x),
                                  self._stage(y))

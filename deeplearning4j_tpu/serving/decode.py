@@ -64,6 +64,7 @@ from ..models import transformer as _transformer
 from ..nn.conf.attention import SelfAttentionLayer
 from ..nn.conf.layers import EmbeddingSequenceLayer
 from ..util import faults as _faults
+from ..util import flightrecorder as _flight
 from ..util import metrics as _metrics
 from ..util import xla as _xla
 from ..util.resilience import SYSTEM_CLOCK, Clock, Deadline
@@ -556,9 +557,12 @@ class DecodeScheduler:
             # strand a request in a queue nothing will ever drain
             if self._draining or self._stopped:
                 self._m_shed.inc(reason="draining")
+                _flight.record("decode_shed", reason="draining")
                 raise SchedulerDraining("decode scheduler is draining")
             if len(self._queue) >= self.max_queue:
                 self._m_shed.inc(reason="decode_queue_full")
+                _flight.record("decode_shed", reason="decode_queue_full",
+                               queue_depth=len(self._queue))
                 raise SchedulerSaturated(
                     "decode queue full", retry_after=1.0)
             self._queue.append(req)
@@ -580,6 +584,9 @@ class DecodeScheduler:
                 progressed = self._prefill_tick() or progressed
                 progressed = self._decode_tick() or progressed
             except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
+                _flight.record("decode_error",
+                               error=f"{type(e).__name__}: {e}",
+                               in_flight=len(self._active))
                 for seq in list(self._active.values()):
                     seq.req.error = f"{type(e).__name__}: {e}"
                     self._retire(seq, "error")
@@ -731,6 +738,9 @@ class DecodeScheduler:
         self._active.pop(seq.lane, None)
         self._finish(seq.req, reason)
         self._m_retired.inc(reason=reason)
+        _flight.record("decode_retired", reason=reason, lane=seq.lane,
+                       tokens=len(seq.req.tokens),
+                       active=len(self._active))
 
     def _finish(self, req: DecodeRequest, reason: str) -> None:
         req.finish_reason = reason

@@ -13,6 +13,14 @@ Endpoints:
                    "breaker": "closed|open|half_open", "draining": bool,
                    "decode": {"active": n, "queued": n} when enabled}
   GET  /metrics   Prometheus text exposition of this server's registry
+  GET  /debug/flightrecorder
+                  the process flight recorder's current event ring as
+                  JSON (util/flightrecorder.py — the black box)
+  POST /profile?seconds=N
+                  capture a jax.profiler device trace (XPlane) for N
+                  seconds (default 1, max 300) into a fresh run
+                  directory; returns {"dir": ...}. One capture at a
+                  time — 409 while one is in progress.
   POST /model     swap the served model from a checkpoint zip path
                   {"path": "/path/to/model.zip"} — refused (409) while
                   generative sequences are in flight; fenced to a decode
@@ -66,6 +74,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -182,22 +191,34 @@ class InferenceServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                path = urlparse(self.path).path
+                if path == "/healthz":
                     self._json(outer._health())
-                elif self.path == "/metrics":
+                elif path == "/metrics":
                     _metrics.write_exposition(self, outer.registry)
                     outer._m_responses.inc(code="200")
+                elif path == "/debug/flightrecorder":
+                    from ..util import flightrecorder as _flight
+                    self._json({"events": _flight.jsonable_events()})
                 else:
                     self._json({"error": "not found"}, 404)
 
             def do_POST(self):
+                url = urlparse(self.path)
+                if url.path == "/profile":
+                    # no JSON body — parameters ride the query string so
+                    # `curl -X POST .../profile?seconds=5` just works
+                    from ..util.profiling import profile_request
+                    body, code = profile_request(parse_qs(url.query))
+                    self._json(body, code)
+                    return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(length).decode())
                 except Exception as e:
                     self._json({"error": f"bad request: {e}"}, 400)
                     return
-                if self.path == "/predict":
+                if url.path == "/predict":
                     try:
                         x = np.asarray(payload["inputs"], dtype=np.float32)
                     except Exception as e:
@@ -210,12 +231,12 @@ class InferenceServer:
                         self._json({"error": err}, code, headers)
                     else:
                         self._json({"outputs": out.tolist()})
-                elif self.path == "/generate":
+                elif url.path == "/generate":
                     body, code, retry_after = outer._generate(payload)
                     headers = ({"Retry-After": f"{retry_after:.0f}"}
                                if retry_after is not None else None)
                     self._json(body, code, headers)
-                elif self.path == "/model":
+                elif url.path == "/model":
                     try:
                         outer.swap_model_from(payload["path"])
                         self._json({"ok": True})
@@ -266,6 +287,9 @@ class InferenceServer:
             "(queue_wait), coalescing window (batch_assembly), and the "
             "batched model call (model_call)", ("phase",))
 
+        # HBM pressure next to the serving numbers it explains
+        from ..util.profiling import register_device_memory_gauges
+        register_device_memory_gauges(reg)
         self._m_queue_depth = reg.gauge(
             "serving_queue_depth", "Requests waiting in the bounded queue")
         self._m_pending = reg.gauge(

@@ -21,6 +21,12 @@ Endpoints:
   GET  /metrics             Prometheus text exposition of the attached
                             metrics registry (process default unless one
                             is passed to UIServer)
+  GET  /debug/flightrecorder the process flight recorder's event ring
+                            (util/flightrecorder.py)
+  POST /profile?seconds=N   capture a jax.profiler device trace for N
+                            seconds (409 while one is in progress) —
+                            profile the TRAINING process the dashboard
+                            watches without touching its code
   POST /api/tsne            upload coords, or raw vectors to embed
   POST /api/remote          receive stats records POSTed by
                             RemoteUIStatsStorageRouter from other hosts
@@ -267,6 +273,9 @@ class _Handler(BaseHTTPRequestHandler):
             # (training listeners, storage routing, phase timings)
             _metrics.write_exposition(self, self.registry
                                       or _metrics.REGISTRY)
+        elif url.path == "/debug/flightrecorder":
+            from ..util import flightrecorder as _flight
+            self._json({"events": _flight.jsonable_events()})
         elif url.path == "/api/sessions":
             self._json(st.list_session_ids())
         elif url.path == "/api/overview":
@@ -386,6 +395,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         url = urlparse(self.path)
+        if url.path == "/profile":
+            # same contract as the inference server's /profile (one
+            # capture at a time, process-wide)
+            from ..util.profiling import profile_request
+            body, code = profile_request(parse_qs(url.query))
+            self._json(body, code)
+            return
         if url.path == "/api/tsne":
             # upload coordinates, or raw vectors to embed server-side
             # (parity: TsneModule's coordinate-file upload)
@@ -449,6 +465,8 @@ class UIServer:
         # training process's MetricsListener / storage-routing counters
         self.registry = registry if registry is not None \
             else _metrics.REGISTRY
+        from .stats import register_device_memory_gauges
+        register_device_memory_gauges(self.registry)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.storage: Optional[StatsStorage] = None

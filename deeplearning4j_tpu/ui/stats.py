@@ -30,17 +30,29 @@ def _host_memory_bytes() -> Optional[int]:
         return None
 
 
-def _device_memory_stats() -> Optional[Dict[str, int]]:
+def _device_memory_stats() -> Optional[Dict[str, Dict[str, int]]]:
+    """Per-device memory stats keyed by device label (the UI pane's
+    feed). None when no backend exposes memory_stats (CPU)."""
+    out: Dict[str, Dict[str, int]] = {}
     try:
         import jax
-        d = jax.devices()[0]
-        stats = d.memory_stats()
-        if stats:
-            return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
-                    "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0))}
+        for d in jax.devices():
+            stats = d.memory_stats()
+            if stats:
+                out[f"{d.platform}:{d.id}"] = {
+                    "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(
+                        stats.get("peak_bytes_in_use", 0))}
     except Exception:
         pass
-    return None
+    return out or None
+
+
+# the gauges themselves live in util/profiling (nothing UI-specific about
+# HBM pressure — the serving layer registers them too); re-exported here
+# because this module's listener is the training-side registration point
+from ..util.profiling import _MEMORY_KINDS  # noqa: F401  (test fixture)
+from ..util.profiling import register_device_memory_gauges  # noqa: F401
 
 
 def _histogram(arr: np.ndarray, bins: int = 20) -> Dict[str, Any]:
@@ -70,6 +82,8 @@ class StatsListener(TrainingListener):
         self.worker_id = worker_id
         self.collect_histograms = collect_histograms
         self.histogram_frequency = max(1, int(histogram_frequency))
+        # HBM pressure belongs on /metrics, not just in posted records
+        register_device_memory_gauges()
         # time/iteration of the last COLLECTED iteration: per-iteration
         # duration is (now - then) / iterations-elapsed. (Touching this
         # every iteration_done under-reported iteration_ms by ~frequency×.)

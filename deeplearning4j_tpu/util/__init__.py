@@ -9,6 +9,7 @@ from .recovery import CheckpointRecovery, RecoverableTrainer
 from . import profiling
 from . import metrics
 from . import tracing
+from . import flightrecorder
 from .metrics import REGISTRY, MetricsRegistry
 from .tracing import Tracer
 from .durable import (AsyncCheckpointWriter, CheckpointStore,
@@ -18,7 +19,8 @@ from .durable import (AsyncCheckpointWriter, CheckpointStore,
 
 __all__ = ["ModelSerializer", "save_model", "load_model",
            "CheckpointRecovery", "RecoverableTrainer", "profiling",
-           "metrics", "tracing", "REGISTRY", "MetricsRegistry", "Tracer",
+           "metrics", "tracing", "flightrecorder", "REGISTRY",
+           "MetricsRegistry", "Tracer",
            "AsyncCheckpointWriter", "CheckpointStore", "DurableSession",
            "DurableTrainer", "PreemptionHandler", "StepWatchdog",
            "TrainingState", "WatchdogTimeout", "is_seekable"]

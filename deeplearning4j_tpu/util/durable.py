@@ -66,6 +66,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 import numpy as np
 
 from . import faults as _faults
+from . import flightrecorder as _flight
 from . import metrics as _metrics
 from .resilience import SYSTEM_CLOCK, Clock
 from .serialization import (CheckpointInvalid, ModelSerializer,
@@ -439,7 +440,11 @@ class CheckpointStore:
         finally:
             shutil.rmtree(wip, ignore_errors=True)
         commits_counter(registry).inc(kind=state.kind)
-        write_seconds_histogram(registry).observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        write_seconds_histogram(registry).observe(dt)
+        _flight.record("checkpoint_commit", name=state.name,
+                       snapshot_kind=state.kind,
+                       write_seconds=round(dt, 4))
         for stale in self.snapshots()[:-self.keep]:
             shutil.rmtree(os.path.join(self.directory, stale),
                           ignore_errors=True)
@@ -749,6 +754,15 @@ class StepWatchdog:
             "depths: %s, breakers: %s, active span: %s",
             self.deadline_s, d["queue_depths"], d["breakers"],
             d["active_span"])
+        # the black-box path: a hung dispatch may never unwind, so the
+        # ring is written to disk HERE, before any interrupt/raise — the
+        # last train_step event in the dump names the step that hung
+        _flight.record("watchdog_expired", deadline_s=self.deadline_s,
+                       elapsed_s=d["elapsed_s"],
+                       queue_depths=d["queue_depths"],
+                       breakers=d["breakers"],
+                       active_span=d["active_span"])
+        _flight.dump(reason="watchdog_expired")
         if self.on_timeout is not None:
             try:
                 self.on_timeout(d)
@@ -760,10 +774,19 @@ class StepWatchdog:
             # monitor-thread expiry: interrupt the (possibly hung) main
             # thread. Synchronous expiry via pet()/check() skips this —
             # the caller's own raise unwinds, and a self-interrupt would
-            # leave a stray KeyboardInterrupt pending for cleanup code
-            import _thread
+            # leave a stray KeyboardInterrupt pending for cleanup code.
+            # A REAL signal (os.kill), not _thread.interrupt_main():
+            # interrupt_main only sets the pending flag, which a main
+            # thread blocked inside a C call (a hung device dispatch,
+            # time.sleep) never reaches — the kernel-delivered SIGINT
+            # EINTRs the blocking call so the handler actually runs
+            # (pinned by the fork-and-kill hang test)
             _WATCHDOG_INTERRUPT.set()
-            _thread.interrupt_main()
+            try:
+                os.kill(os.getpid(), signal.SIGINT)
+            except Exception:
+                import _thread
+                _thread.interrupt_main()
 
     def _monitor(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
@@ -813,11 +836,18 @@ class PreemptionHandler:
             raise KeyboardInterrupt(
                 "step watchdog expired — unwinding hung dispatch")
         if self._event.is_set():
+            _flight.record("preemption_abort", signum=int(signum))
+            _flight.dump(reason="second_signal")
             raise KeyboardInterrupt(
                 f"second signal {signum} during drain — aborting")
         logger.warning(
             "signal %d: draining in-flight work and writing a final "
             "checkpoint (send again to abort)", signum)
+        # black-box the preemption instant: if the drain itself wedges or
+        # the enclosing job is hard-killed mid-drain, the dump already
+        # names the last dispatched step
+        _flight.record("preemption_signal", signum=int(signum))
+        _flight.dump(reason="preemption")
         self._event.set()
 
     def install(self) -> "PreemptionHandler":
@@ -1029,6 +1059,9 @@ class DurableTrainer:
                  commit_gate: Optional[Callable[[str], bool]] = "default",
                  registry=None):
         self.store = CheckpointStore(directory, keep=keep)
+        # a durable run is exactly the kind whose crash needs a black
+        # box: any unhandled exception dumps the flight recorder
+        _flight.install_excepthook()
         self.frequency = max(1, int(frequency))
         self.async_writes = async_writes
         self.watchdog_s = watchdog_s

@@ -144,6 +144,10 @@ class FaultPlan:
                      **seam_context()})
         if rule is None:
             return
+        # scripted faults are significant events by definition: the chaos
+        # dump must show the injected failure next to its consequences
+        from . import flightrecorder as _flight
+        _flight.record("fault_injected", site=site, call=n)
         fault = rule.fault
         if isinstance(fault, BaseException):
             raise fault

@@ -50,6 +50,7 @@ from typing import Iterable, Iterator, Optional, Tuple
 import numpy as np
 
 from . import faults as _faults
+from . import flightrecorder as _flight
 from . import metrics as _metrics
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -219,6 +220,70 @@ def host_gap_histogram(registry=None) -> _metrics.Histogram:
         "Host time between consecutive step dispatches in fit() (batch "
         "fetch + listener work; device compute excluded)", ("model",),
         buckets=_GAP_BUCKETS)
+
+
+def measured_flops_gauge(registry=None) -> _metrics.Gauge:
+    return _reg(registry).gauge(
+        "measured_flops_per_sec",
+        "Live training FLOP/s: the compiled train step's HLO "
+        "cost-analysis FLOPs (compiled_flops) over wall time between "
+        "dispatches — measured, not analytic", ("model",))
+
+
+def measured_mfu_gauge(registry=None) -> _metrics.Gauge:
+    return _reg(registry).gauge(
+        "measured_mfu",
+        "Live model FLOPs utilization: measured_flops_per_sec over the "
+        "attached chip's published bf16 peak (series absent when the "
+        "device kind has no known peak — CPU runs read "
+        "measured_flops_per_sec instead)", ("model",))
+
+
+class _MfuMeter:
+    """Live measured-performance gauges for :func:`run_fit_loop`.
+
+    Combines the guarded train step's cost-analysis FLOPs
+    (``compiled_flops{fn}``, recorded by ``util.xla.retrace_guard`` at
+    compile time) with wall time between dispatches into
+    ``measured_flops_per_sec{model}`` and — when the chip's peak is known
+    — ``measured_mfu{model}``. The first dispatch (the compiling one)
+    only anchors the clock: its wall time is compile, not compute.
+    Unknown peaks (CPU) degrade to the flops/sec gauge; an unguarded step
+    override (no compiled_flops series) records nothing.
+    """
+
+    def __init__(self, model_label: str, registry=None):
+        from . import profiling as _profiling
+        from . import xla as _xla
+        self.model_label = model_label
+        self._flops = _xla.compiled_flops_gauge(registry)
+        self._rate = measured_flops_gauge(registry)
+        self._mfu = measured_mfu_gauge(registry)
+        try:
+            self._peak = _profiling.peak_flops_per_sec()
+        except Exception:
+            self._peak = None
+        self._t0: Optional[float] = None
+        self._total = 0.0
+
+    def on_dispatch(self, kind: str) -> None:
+        fn = (f"{self.model_label}.train_scan" if kind == "scan"
+              else f"{self.model_label}.train_step")
+        now = time.perf_counter()
+        if self._t0 is None:
+            self._t0 = now
+            return
+        flops = self._flops.value(fn=fn)
+        if not flops:
+            return
+        self._total += flops
+        elapsed = now - self._t0
+        if elapsed <= 0:
+            return
+        rate = self._total / elapsed
+        self._rate.set(rate, model=self.model_label)
+        if self._peak:
+            self._mfu.set(rate / self._peak, model=self.model_label)
 
 
 # ----------------------------------------------------------------------
@@ -517,6 +582,16 @@ def run_fit_loop(net, data, labels, mask, epochs: int,
     checkpointing/watchdog petting, and — when the session asks to stop
     (preemption, max_steps) — drains the in-flight window and returns
     cleanly WITHOUT counting the partial epoch.
+
+    Observability riders: every dispatched step lands a ``train_step``
+    flight-recorder event (the black box a watchdog/preemption dump
+    replays); a :class:`_MfuMeter` keeps ``measured_mfu{model}`` /
+    ``measured_flops_per_sec{model}`` live from the compiled step's
+    cost-analysis FLOPs; and ``DL4JTPU_PROFILE_STEPS=start:stop[:dir]``
+    brackets exactly that dispatch range (0-based, stop-exclusive,
+    counted across epochs within this call) with a ``jax.profiler``
+    capture — the in-flight window is drained before the profiler stops,
+    so the bracketed steps' device work lands inside the trace.
     """
     single = (labels is not None or hasattr(data, "shape")
               or hasattr(data, "features")
@@ -533,68 +608,105 @@ def run_fit_loop(net, data, labels, mask, epochs: int,
         k = 0
     elif net.listeners and coalesce is None:
         k = 0
+    from . import profiling as _profiling
     gap_hist = host_gap_histogram()
+    meter = _MfuMeter(model_label)
+    profile_range = _profiling.profile_steps_env()
+    capture = (_profiling.StepCapture(profile_range[2])
+               if profile_range is not None else None)
+    dispatch_idx = 0
     # a session resuming a mid-epoch cursor must not "revive" the source
     # on its first epoch: a cursor at the exact end of the data means
     # zero batches remain, not restart-from-scratch
     revive_ok = not (session is not None
                      and getattr(session, "resuming", False))
-    for epoch in range(epochs):
-        if hasattr(data, "reset") and (
-                epoch > 0 or (revive_ok and hasattr(data, "has_next")
-                              and not data.has_next())):
-            data.reset()
-        for l in net.listeners:
-            l.on_epoch_start(net, net.epoch_count)
-        window = InflightWindow()
-        source = net._as_batches(data, labels, mask)
-        if session is not None:
-            source = session.tap(source, data)
-        staged = None
-        if not single and staging_enabled() and not already_staged(data):
-            staged = stage(source, stage_name="fit",
-                           tracer=getattr(net, "ingest_tracer", None))
-            source = staged
-        n_batches = 0
-        t_prev = None
-        stopped = False
-        try:
-            for kind, payload in coalesced(source, k):
-                t_now = time.perf_counter()
-                if t_prev is not None:
-                    gap_hist.observe(t_now - t_prev, model=model_label)
-                _faults.check("training.step", {
-                    "model": model_label, "epoch": net.epoch_count,
-                    "iteration": net.iteration_count, "kind": kind})
-                if kind == "scan":
-                    xs, ys = payload
-                    window.push(net.fit_scan(xs, ys))
-                    consumed = int(xs.shape[0])
-                else:
-                    window.push(net.fit_batch(*payload))
-                    consumed = 1
-                n_batches += consumed
-                if session is not None and not session.on_step(net,
-                                                               consumed):
-                    # clean stop (preemption / max_steps): every
-                    # dispatched step must land before the caller
-                    # checkpoints the stop instant
+    window = None
+    try:
+        for epoch in range(epochs):
+            if hasattr(data, "reset") and (
+                    epoch > 0 or (revive_ok and hasattr(data, "has_next")
+                                  and not data.has_next())):
+                data.reset()
+            for l in net.listeners:
+                l.on_epoch_start(net, net.epoch_count)
+            window = InflightWindow()
+            source = net._as_batches(data, labels, mask)
+            if session is not None:
+                source = session.tap(source, data)
+            staged = None
+            if not single and staging_enabled() and not already_staged(data):
+                staged = stage(source, stage_name="fit",
+                               tracer=getattr(net, "ingest_tracer", None))
+                source = staged
+            n_batches = 0
+            t_prev = None
+            stopped = False
+            try:
+                for kind, payload in coalesced(source, k):
+                    t_now = time.perf_counter()
+                    if t_prev is not None:
+                        gap_hist.observe(t_now - t_prev, model=model_label)
+                    if (capture is not None and not capture.active
+                            and dispatch_idx == profile_range[0]):
+                        capture.start()
+                    _flight.record(
+                        "train_step", model=model_label,
+                        epoch=net.epoch_count,
+                        iteration=net.iteration_count, dispatch=kind,
+                        host_gap_s=(round(t_now - t_prev, 6)
+                                    if t_prev is not None else None))
+                    _faults.check("training.step", {
+                        "model": model_label, "epoch": net.epoch_count,
+                        "iteration": net.iteration_count, "kind": kind})
+                    if kind == "scan":
+                        xs, ys = payload
+                        window.push(net.fit_scan(xs, ys))
+                        consumed = int(xs.shape[0])
+                    else:
+                        window.push(net.fit_batch(*payload))
+                        consumed = 1
+                    meter.on_dispatch(kind)
+                    dispatch_idx += 1
+                    if (capture is not None and capture.active
+                            and dispatch_idx >= profile_range[1]):
+                        # the bracketed steps' device work must land
+                        # inside the capture, not after it
+                        window.drain()
+                        capture.stop()
+                    n_batches += consumed
+                    if session is not None and not session.on_step(
+                            net, consumed):
+                        # clean stop (preemption / max_steps): every
+                        # dispatched step must land before the caller
+                        # checkpoints the stop instant
+                        window.drain()
+                        stopped = True
+                        break
+                    t_prev = time.perf_counter()
+            finally:
+                if staged is not None:
+                    staged.close()
+            if stopped:
+                return      # partial epoch: no epoch_end, no count bump
+            if n_batches == 0 and epoch > 0:
+                raise ValueError(
+                    f"epoch {epoch} yielded no batches — the data "
+                    "iterator is exhausted and has no reset(); pass a "
+                    "resettable iterator (e.g. "
+                    "datasets.ListDataSetIterator) when epochs > 1")
+            for l in net.listeners:
+                l.on_epoch_end(net, net.epoch_count)
+            net.epoch_count += 1
+            if session is not None:
+                session.on_epoch_boundary(net)
+    finally:
+        if capture is not None and capture.active:
+            # same contract as the in-loop stop: the bracketed steps'
+            # device work must land inside the trace, even when the fit
+            # ran out of batches (or raised) before reaching `stop`
+            if window is not None:
+                try:
                     window.drain()
-                    stopped = True
-                    break
-                t_prev = time.perf_counter()
-        finally:
-            if staged is not None:
-                staged.close()
-        if stopped:
-            return          # partial epoch: no epoch_end, no count bump
-        if n_batches == 0 and epoch > 0:
-            raise ValueError(
-                f"epoch {epoch} yielded no batches — the data iterator is "
-                "exhausted and has no reset(); pass a resettable iterator "
-                "(e.g. datasets.ListDataSetIterator) when epochs > 1")
-        for l in net.listeners:
-            l.on_epoch_end(net, net.epoch_count)
-        net.epoch_count += 1
-        if session is not None:
-            session.on_epoch_boundary(net)
+                except Exception:
+                    pass    # a failed dispatch still ends the capture
+            capture.stop()

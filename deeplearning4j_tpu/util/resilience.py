@@ -260,6 +260,12 @@ class CircuitBreaker:
                 self._pending_transitions, [])
         hook = self.on_transition
         for old, new in pending:
+            # every transition lands in the process flight recorder (a
+            # breaker flapping open right before a stall is exactly what
+            # a post-mortem dump must show), independent of any hook
+            from . import flightrecorder as _flight
+            _flight.record("breaker_transition", breaker=self.name,
+                           from_state=old, to_state=new)
             if hook is not None:
                 try:
                     hook(self.name, old, new)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -79,7 +80,7 @@ def trace_env_key() -> str:
 
 def keyed_jit(cache: Dict[str, Any], fn: Callable, *, extra: str = "",
               wrap: Optional[Callable[[Callable], Callable]] = None,
-              **jit_kw):
+              name: Optional[str] = None, registry=None, **jit_kw):
     """ONE copy of the trace-env-keyed jit-cache lookup the sharded
     trainers use: returns the jit of ``fn`` cached under the CURRENT
     :func:`trace_env_key`, compiling a fresh one when a routing flag has
@@ -90,7 +91,10 @@ def keyed_jit(cache: Dict[str, Any], fn: Callable, *, extra: str = "",
     flag state (e.g. the decode engine's per-bucket step functions);
     ``wrap`` post-processes a freshly built jit exactly once (e.g.
     :func:`retrace_guard`), so the wrapper's own state survives cache
-    hits."""
+    hits. ``name`` (when ``wrap`` is not given) wraps the fresh jit in a
+    :func:`retrace_guard` under that name — retrace counting plus the
+    compile-time/cost-analysis metrics — so every keyed trainer step is a
+    measured jit site without each caller re-spelling the guard."""
     import jax
     key = trace_env_key() + (f"|{extra}" if extra else "")
     jitted = cache.get(key)
@@ -98,8 +102,85 @@ def keyed_jit(cache: Dict[str, Any], fn: Callable, *, extra: str = "",
         jitted = jax.jit(fn, **jit_kw)
         if wrap is not None:
             jitted = wrap(jitted)
+        elif name is not None:
+            jitted = retrace_guard(jitted, name, registry)
         cache[key] = jitted
     return jitted
+
+
+# ----------------------------------------------------------------------
+# compiled-cost metrics: measured FLOPs/bytes + compile wall time
+# ----------------------------------------------------------------------
+
+# compile times span ms (tiny eval programs) to minutes (large train
+# steps on a real TPU) — the default RPC-latency buckets top out at 10s
+_COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def _reg(registry=None):
+    from . import metrics as _metrics
+    return registry if registry is not None else _metrics.REGISTRY
+
+
+def compile_seconds_histogram(registry=None):
+    return _reg(registry).histogram(
+        "xla_compile_seconds",
+        "Wall time of each fresh compilation (trace + XLA compile) per "
+        "guarded jitted function", ("fn",), buckets=_COMPILE_BUCKETS)
+
+
+def compiled_flops_gauge(registry=None):
+    return _reg(registry).gauge(
+        "compiled_flops",
+        "HLO cost-analysis FLOPs of the most recently compiled program "
+        "per guarded jitted function (measured from the lowered module, "
+        "not an analytic formula)", ("fn",))
+
+
+def compiled_bytes_gauge(registry=None):
+    return _reg(registry).gauge(
+        "compiled_bytes",
+        "HLO cost-analysis bytes accessed of the most recently compiled "
+        "program per guarded jitted function", ("fn",))
+
+
+def cost_analysis_enabled() -> bool:
+    """``DL4JTPU_COST_ANALYSIS=0`` skips the per-compile HLO cost
+    analysis (the lowering re-walk costs ~0.1s per fresh signature on a
+    small transformer — ~4% of its compile time — but a caller compiling
+    thousands of tiny programs may want it off)."""
+    return os.environ.get("DL4JTPU_COST_ANALYSIS", "1") != "0"
+
+
+def compiled_costs(fn: Callable, *args, **kwargs) -> Optional[Dict[str, float]]:
+    """Measured cost of the program ``fn`` compiles for these arguments:
+    ``{"flops": ..., "bytes_accessed": ...}`` from the lowered module's
+    HLO cost analysis, or None when unavailable.
+
+    Uses ``Lowered.cost_analysis()`` — NO second backend compile: after
+    the jit call itself compiled, re-lowering rides the warm jaxpr cache
+    and the analysis walks unoptimized HLO (matmul FLOPs are identical to
+    the optimized program's; elementwise counts differ by <1% on the
+    models in-tree). Safe after donation: lowering only needs avals,
+    never the (possibly consumed) buffers."""
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        return None
+    try:
+        ca = lower(*args, **kwargs).cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out: Dict[str, float] = {}
+    if ca.get("flops"):
+        out["flops"] = float(ca["flops"])
+    if ca.get("bytes accessed"):
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out or None
 
 
 # ----------------------------------------------------------------------
@@ -125,7 +206,8 @@ def _abstract_signature(args: tuple, kwargs: dict) -> Tuple:
 
 def retrace_guard(fn: Callable, name: str, registry=None) -> Callable:
     """Wrap a jitted callable to count compilations into
-    ``jit_retraces_total{fn=name}``.
+    ``jit_retraces_total{fn=name}`` and record each fresh compile's
+    measured cost.
 
     Each call computes the abstract signature of its arguments (shape +
     dtype skeleton — the same thing jit keys its cache on); a signature
@@ -133,28 +215,69 @@ def retrace_guard(fn: Callable, name: str, registry=None) -> Callable:
     training therefore pins the counter at exactly 1 per guarded step
     function, and the no-retrace regression test enforces it on CPU.
 
+    A fresh signature additionally records:
+
+    - ``xla_compile_seconds{fn}`` — wall time of the compiling call
+      (trace + XLA compile; dispatch is async, so execution is excluded);
+    - ``compiled_flops{fn}`` / ``compiled_bytes{fn}`` — the lowered
+      program's HLO cost analysis (:func:`compiled_costs`), the MEASURED
+      counterpart of the analytic formulas in bench.py — plus the latest
+      analysis on ``wrapped.compiled_costs``;
+    - a ``compile`` flight-recorder event (retraces after the first carry
+      the differing signature, so a post-mortem dump names the churning
+      input).
+
     ``DL4JTPU_RETRACE_WARN=1`` additionally logs every retrace after the
     first with the differing abstract signature — the fastest way to find
     which input's shape/dtype is churning the compile cache.
     """
+    from . import flightrecorder as _flight
     from . import ingest as _ingest
     counter = _ingest.retrace_counter(registry)
+    compile_hist = compile_seconds_histogram(registry)
+    flops_gauge = compiled_flops_gauge(registry)
+    bytes_gauge = compiled_bytes_gauge(registry)
     seen: Dict[Tuple, int] = {}
     last: list = []
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         key = _abstract_signature(args, kwargs)
-        if key not in seen:
-            seen[key] = len(seen)
-            counter.inc(fn=name)
-            if seen[key] > 0 and os.environ.get("DL4JTPU_RETRACE_WARN") == "1":
-                logger.warning(
-                    "retrace #%d of %s — new abstract signature:\n  now:  "
-                    "%s\n  prev: %s", len(seen) - 1, name, key[1],
-                    last[0][1] if last else "?")
-            last[:] = [key]
-        return fn(*args, **kwargs)
+        if key in seen:
+            return fn(*args, **kwargs)
+        idx = seen[key] = len(seen)
+        counter.inc(fn=name)
+        if idx > 0 and os.environ.get("DL4JTPU_RETRACE_WARN") == "1":
+            logger.warning(
+                "retrace #%d of %s — new abstract signature:\n  now:  "
+                "%s\n  prev: %s", idx, name, key[1],
+                last[0][1] if last else "?")
+        prev = last[0][1] if last else None
+        last[:] = [key]
+        # the compiling call: trace + compile happen synchronously inside
+        # it, execution is dispatched async — so the wall time here IS
+        # the compile cost the caller paid
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        compile_hist.observe(dt, fn=name)
+        event = {"fn": name, "signature_idx": idx,
+                 "compile_seconds": round(dt, 4)}
+        costs = (compiled_costs(fn, *args, **kwargs)
+                 if cost_analysis_enabled() else None)
+        if costs is not None:
+            wrapped.compiled_costs = costs
+            if "flops" in costs:
+                flops_gauge.set(costs["flops"], fn=name)
+                event["flops"] = costs["flops"]
+            if "bytes_accessed" in costs:
+                bytes_gauge.set(costs["bytes_accessed"], fn=name)
+        if idx > 0:
+            event["signature"] = str(key[1])
+            event["prev_signature"] = str(prev)
+        _flight.record("compile", **event)
+        return out
 
     wrapped.signatures_seen = seen
+    wrapped.compiled_costs = None
     return wrapped

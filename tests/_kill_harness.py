@@ -15,7 +15,13 @@ writes ``result.json`` (iteration/epoch counters, per-iteration scores,
 sha256 param digest) into the checkpoint directory on clean completion.
 
 Config keys: checkpoint_dir, total_epochs, frequency,
-kill_mode (None | "exit" | "sigterm"), kill_at_iteration, seed.
+kill_mode (None | "exit" | "sigterm" | "hang"), kill_at_iteration, seed,
+watchdog_s (arms DurableTrainer's StepWatchdog — pair with "hang", which
+sleeps forever at the step seam so the watchdog's monitor thread must
+notice, dump the flight recorder, and interrupt the hung dispatch).
+The flight recorder dumps into checkpoint_dir (DL4JTPU_FLIGHT_DIR is set
+before training starts), so the parent can read the black box of a child
+that died hung.
 """
 
 from __future__ import annotations
@@ -99,11 +105,14 @@ def _child_main(config: dict) -> None:
     directory = config["checkpoint_dir"]
     kill_mode = config.get("kill_mode")
     kill_at = config.get("kill_at_iteration")
+    # the black box lands next to the checkpoints, where the parent looks
+    os.environ["DL4JTPU_FLIGHT_DIR"] = directory
 
     trainer = DurableTrainer(
         build_net(config.get("seed", 7)), directory,
         frequency=config.get("frequency", 2), handle_signals=True,
-        async_writes=config.get("async", True))
+        async_writes=config.get("async", True),
+        watchdog_s=config.get("watchdog_s"))
 
     scores = []
 
@@ -136,6 +145,12 @@ def _child_main(config: dict) -> None:
             if payload["iteration"] == kill_at:
                 if kill_mode == "exit":
                     os._exit(9)              # hard kill: nothing drains
+                if kill_mode == "hang":
+                    # a wedged dispatch: only the watchdog's monitor
+                    # thread can notice (this thread never pets again)
+                    import time
+                    time.sleep(600)
+                    return
                 os.kill(os.getpid(), signal.SIGTERM)
         plan.always("training.step", exc=kill)
 

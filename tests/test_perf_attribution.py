@@ -1,0 +1,379 @@
+"""Measured performance attribution (ISSUE 10): compiled-cost metrics
+from the HLO cost analysis, the live measured-MFU gauges, on-demand
+profiler capture over HTTP, and the crash/stall flight recorder."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util import flightrecorder as _flight
+from deeplearning4j_tpu.util import metrics as _metrics
+from deeplearning4j_tpu.util import profiling as _profiling
+
+
+def _small_mln(seed=3):
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater("adam")
+            .learning_rate(0.01).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n, batch=8, features=5, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        x = rng.normal(size=(batch, features)).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[
+            rng.integers(0, classes, batch)]
+        yield x, y
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def _post(url):
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+# ----------------------------------------------------------------------
+# compiled-cost metrics + measured MFU
+# ----------------------------------------------------------------------
+
+class TestCompiledCostMetrics:
+    def test_fit_records_compile_time_flops_and_live_gauges(self):
+        net = _small_mln()
+        net.fit(_batches(6))
+
+        hist = _metrics.REGISTRY.get("xla_compile_seconds")
+        assert hist is not None
+        assert hist.count(fn="MultiLayerNetwork.train_step") >= 1
+        assert hist.sum(fn="MultiLayerNetwork.train_step") > 0
+
+        flops = _metrics.REGISTRY.get("compiled_flops")
+        assert flops is not None
+        assert flops.value(fn="MultiLayerNetwork.train_step") > 0
+        bytes_g = _metrics.REGISTRY.get("compiled_bytes")
+        assert bytes_g.value(fn="MultiLayerNetwork.train_step") > 0
+
+        # the live measured gauge: CPU has no published peak, so
+        # measured_mfu degrades to a flops/sec series (the family is
+        # still registered — the acceptance surface exists everywhere)
+        rate = _metrics.REGISTRY.get("measured_flops_per_sec")
+        assert rate is not None
+        assert rate.value(model="MultiLayerNetwork") > 0
+        mfu_g = _metrics.REGISTRY.get("measured_mfu")
+        assert mfu_g is not None
+        assert not [s for s in mfu_g.snapshot()["series"]
+                    if s["labels"].get("model") == "MultiLayerNetwork"]
+
+    def test_compile_flight_event_recorded(self):
+        net = _small_mln(seed=11)
+        before = len(_flight.events("compile"))
+        net.fit(_batches(3))
+        events = _flight.events("compile")
+        assert len(events) > before
+        e = [x for x in events
+             if x["fn"] == "MultiLayerNetwork.train_step"][-1]
+        assert e["compile_seconds"] > 0
+        assert e.get("flops", 0) > 0
+
+    def test_inference_server_metrics_exposition(self):
+        """Acceptance: GET /metrics on a live InferenceServer (aggregating
+        into the process registry) shows xla_compile_seconds,
+        compiled_flops, and — after a fit — the measured gauges."""
+        from deeplearning4j_tpu.serving.server import InferenceServer
+
+        net = _small_mln(seed=23)
+        net.fit(_batches(4))
+        server = InferenceServer(net, port=0, registry=_metrics.REGISTRY)
+        try:
+            code, body = _get(
+                f"http://127.0.0.1:{server.port}/metrics")
+            assert code == 200
+            assert "xla_compile_seconds_bucket{" in body
+            assert 'compiled_flops{fn="MultiLayerNetwork.train_step"}' \
+                in body
+            assert "# TYPE measured_mfu gauge" in body
+            assert ('measured_flops_per_sec{model="MultiLayerNetwork"}'
+                    in body)
+            assert "# TYPE device_memory_bytes gauge" in body
+        finally:
+            server.stop(drain=False)
+
+
+class TestCostAnalysisVsAnalytic:
+    def test_transformer_compiled_flops_match_analytic_within_10pct(self):
+        """The acceptance pin: the compiled transformer train step's HLO
+        cost-analysis FLOPs agree with bench.py's analytic formula within
+        10% (GPT-2-shaped config scaled so CPU compiles it in seconds —
+        same formula, matmul-dominated dims; bench.py runs the identical
+        cross-check on the full d768/L12/T2048 config on device days)."""
+        import bench
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+
+        V, T, b, d_model, n_layers = 4096, 128, 4, 256, 2
+        d_ff = 4 * d_model
+        net = ComputationGraph(transformer_lm(
+            V, n_layers=n_layers, d_model=d_model,
+            n_heads=d_model // 64, d_ff=d_ff, learning_rate=3e-4,
+            input_ids=True)).init()
+        rng = np.random.default_rng(19)
+        ids = rng.integers(0, V, (b, T + 1)).astype(np.int32)
+        net.fit_batch([ids[:, :-1]], [ids[:, 1:]], None)
+
+        measured = _metrics.REGISTRY.get("compiled_flops").value(
+            fn="ComputationGraph.train_step")
+        assert measured > 0
+        analytic = bench._transformer_train_flops_per_token(
+            d_model, n_layers, d_ff, V, T) * b * T
+        deviation = abs(measured - analytic) / analytic
+        assert deviation < 0.10, (
+            f"compiled {measured:.3e} vs analytic {analytic:.3e}: "
+            f"{100 * deviation:.1f}% apart")
+
+    def test_bench_crosscheck_flags_drift(self):
+        import bench
+        res = bench._mfu_crosscheck("ComputationGraph.train_step", 1.0)
+        # the gauge still holds the previous test's transformer step —
+        # an absurd analytic value must trip the drift flag
+        if "flops_deviation_pct" in res:
+            assert res["flops_deviation_exceeds_warn"]
+        else:
+            assert res["flops_crosscheck"] == "unavailable"
+
+
+# ----------------------------------------------------------------------
+# on-demand profiler capture
+# ----------------------------------------------------------------------
+
+class TestProfileEndpoint:
+    def test_profile_captures_and_409s_while_busy(self, tmp_path):
+        from deeplearning4j_tpu.serving.server import InferenceServer
+
+        net = _small_mln(seed=5)
+        server = InferenceServer(net, port=0)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            results = {}
+
+            def long_capture():
+                try:
+                    results["first"] = _post(
+                        f"{base}/profile?seconds=1.0&dir={tmp_path}")
+                except Exception as e:   # surfaced by the assert below
+                    results["first"] = ("error", repr(e))
+
+            t = threading.Thread(target=long_capture, daemon=True)
+            t.start()
+            # generous deadlines: this runs under full-suite load where
+            # the HTTP round-trip alone can take seconds
+            deadline = time.time() + 20.0
+            while (not _profiling.capture_in_progress()
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            assert _profiling.capture_in_progress()
+            code, body = _post(f"{base}/profile?seconds=0.1")
+            assert code == 409
+            t.join(30.0)
+            assert "first" in results, "capture request never returned"
+            code, body = results["first"]
+            assert code == 200, (code, body)
+            assert body["ok"] and os.path.isdir(body["dir"])
+            assert body["dir"].startswith(str(tmp_path))
+        finally:
+            server.stop(drain=False)
+
+    def test_profile_rejects_bad_seconds(self):
+        from deeplearning4j_tpu.serving.server import InferenceServer
+
+        net = _small_mln(seed=7)
+        server = InferenceServer(net, port=0)
+        try:
+            code, _ = _post(
+                f"http://127.0.0.1:{server.port}/profile?seconds=bogus")
+            assert code == 400
+            code, _ = _post(
+                f"http://127.0.0.1:{server.port}/profile?seconds=0")
+            assert code == 400
+        finally:
+            server.stop(drain=False)
+
+    def test_ui_server_profile_and_flightrecorder(self, tmp_path):
+        from deeplearning4j_tpu.storage.stats_storage import (
+            InMemoryStatsStorage)
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        base = f"http://127.0.0.1:{ui.port}"
+        try:
+            code, body = _post(f"{base}/profile?seconds=0.05&dir={tmp_path}")
+            assert code == 200 and body["ok"]
+            _flight.record("ui_test_marker", n=1)
+            code, raw = _get(f"{base}/debug/flightrecorder")
+            assert code == 200
+            kinds = [e["kind"] for e in json.loads(raw)["events"]]
+            assert "ui_test_marker" in kinds
+        finally:
+            ui.stop()
+
+    def test_profile_steps_env_brackets_fit(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_PROFILE_STEPS", f"1:3:{tmp_path}")
+        net = _small_mln(seed=13)
+        net.fit(_batches(5))
+        assert not _profiling.capture_in_progress()
+        found = []
+        for root, _, files in os.walk(tmp_path):
+            found += [f for f in files if f.endswith(".xplane.pb")]
+        assert found, "bracketed capture should write an xplane trace"
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+# ----------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self):
+        rec = _flight.FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("tick", i=i)
+        evs = rec.events()
+        assert len(evs) == 4
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]
+        assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+
+    def test_dump_round_trips_and_stringifies_unserializable(self, tmp_path):
+        rec = _flight.FlightRecorder(capacity=8)
+        rec.record("weird", obj=object())
+        path = rec.dump(path=str(tmp_path / "fr.jsonl"), reason="test")
+        evs = _flight.read_jsonl(path)
+        assert evs[0]["kind"] == "weird"
+        assert evs[-1]["kind"] == "dump"
+        assert evs[-1]["reason"] == "test"
+
+    def test_breaker_transitions_feed_the_recorder(self):
+        from deeplearning4j_tpu.util.resilience import CircuitBreaker
+
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0,
+                            name="fr-test-breaker")
+        br.record_failure()
+        evs = [e for e in _flight.events("breaker_transition")
+               if e.get("breaker") == "fr-test-breaker"]
+        assert evs and evs[-1]["to_state"] == "open"
+
+    def test_fault_triggers_feed_the_recorder(self):
+        from deeplearning4j_tpu.util import faults
+
+        plan = faults.FaultPlan().fail("serving.infer", times=1)
+        with plan.active():
+            with pytest.raises(faults.InjectedFault):
+                faults.check("serving.infer", {"batch": 1})
+        evs = [e for e in _flight.events("fault_injected")
+               if e.get("site") == "serving.infer"]
+        assert evs
+
+    def test_serving_debug_endpoint(self):
+        from deeplearning4j_tpu.serving.server import InferenceServer
+
+        net = _small_mln(seed=17)
+        server = InferenceServer(net, port=0)
+        try:
+            _flight.record("serving_test_marker")
+            code, raw = _get(f"http://127.0.0.1:{server.port}"
+                             "/debug/flightrecorder")
+            assert code == 200
+            kinds = [e["kind"] for e in json.loads(raw)["events"]]
+            assert "serving_test_marker" in kinds
+        finally:
+            server.stop(drain=False)
+
+
+@pytest.mark.chaos
+class TestHungDispatchBlackBox:
+    def test_hung_run_leaves_parseable_flight_dump(self, tmp_path):
+        """Acceptance: a training run that HANGS mid-dispatch (fault hook
+        sleeps forever at the step seam) and is killed by the watchdog
+        leaves a readable flight-recorder JSONL whose final train_step
+        event names the hung step."""
+        import _kill_harness as H
+
+        ckpt = str(tmp_path / "ckpt")
+        rc, err = H.run_child({
+            "checkpoint_dir": ckpt, "total_epochs": 2, "frequency": 2,
+            "kill_mode": "hang", "kill_at_iteration": 4,
+            "watchdog_s": 2.0}, timeout=120.0)
+        assert rc != 0, f"hung child should die by watchdog: {err}"
+        assert "WatchdogTimeout" in err
+
+        dumps = [f for f in os.listdir(ckpt)
+                 if f.startswith("flightrecorder_")
+                 and f.endswith(".jsonl")]
+        assert dumps, f"no flight dump in {ckpt}: {os.listdir(ckpt)}"
+        events = _flight.read_jsonl(os.path.join(ckpt, dumps[0]))
+        kinds = [e["kind"] for e in events]
+        assert "watchdog_expired" in kinds
+        steps = [e for e in events if e["kind"] == "train_step"]
+        assert steps, "dump should carry the step trail"
+        # the seam hook hung BEFORE dispatching the step after iteration
+        # 4 — the recorder's last step event is exactly that boundary
+        last_step = steps[-1]
+        assert last_step["iteration"] == 4
+        wd = [e for e in events if e["kind"] == "watchdog_expired"][-1]
+        assert wd["deadline_s"] == 2.0
+
+
+class TestDeviceMemoryGauges:
+    def test_gauges_registered_per_device(self):
+        from deeplearning4j_tpu.ui.stats import (
+            register_device_memory_gauges)
+
+        reg = _metrics.MetricsRegistry()
+        g = register_device_memory_gauges(reg)
+        assert reg.get("device_memory_bytes") is g
+        # CPU backends expose no memory_stats: the callbacks raise at
+        # exposition and the series drop, leaving just the family header
+        body = reg.expose()
+        assert "# TYPE device_memory_bytes gauge" in body
+        import jax
+        if jax.devices()[0].memory_stats():
+            assert 'kind="in_use"' in body
+
+    def test_callback_samples_live_stats(self):
+        class FakeDevice:
+            platform, id = "tpu", 0
+
+            def memory_stats(self):
+                return {"bytes_in_use": 123, "peak_bytes_in_use": 456,
+                        "bytes_limit": 1000}
+
+        from deeplearning4j_tpu.ui import stats as ui_stats
+        reg = _metrics.MetricsRegistry()
+        g = reg.gauge("device_memory_bytes", "", ("device", "kind"))
+        d = FakeDevice()
+        for kind, key in ui_stats._MEMORY_KINDS:
+            g.set_function(
+                (lambda dev, k: lambda: float(dev.memory_stats()[k]))(
+                    d, key), device="tpu:0", kind=kind)
+        assert g.value(device="tpu:0", kind="in_use") == 123
+        assert g.value(device="tpu:0", kind="peak") == 456
+        assert g.value(device="tpu:0", kind="limit") == 1000

@@ -14,6 +14,15 @@ class TestMfuArithmetic:
         # 1000 ex/s at 1e9 FLOP/example on a 1e13 peak = 10% MFU
         assert profiling.mfu(1000.0, 1e9, peak=1e13) == pytest.approx(0.1)
 
+    def test_peak_returns_none_for_unknown_kind(self):
+        # the CPU test device is not a TPU: no published peak, no raise —
+        # callers decide what "no denominator" means
+        assert profiling.peak_flops_per_sec() is None
+
+    def test_mfu_without_peak_raises_on_unknown_device(self):
+        with pytest.raises(ValueError, match="peak"):
+            profiling.mfu(1000.0, 1e9)
+
     def test_train_flops_is_3x_forward(self):
         assert profiling.train_flops(7.0) == 21.0
 
@@ -56,3 +65,86 @@ class TestTrace:
         for root, _, files in os.walk(tmp_path):
             found += [f for f in files if f.endswith(".xplane.pb")]
         assert found, "profiler should write an xplane trace"
+
+
+class TestBarrier:
+    def test_barrier_fences_every_device_leaf(self, monkeypatch):
+        """Regression: a multi-output step (params, opt_state, loss) used
+        to be 'fenced' by a d2h read of only the FIRST leaf — later
+        outputs could still be executing when time_steps stamped the
+        sample."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves = (jnp.ones((4,)), jnp.ones((2, 2)), jnp.zeros((3,)))
+        fenced = []
+        real_ravel = jax.numpy.ravel
+        monkeypatch.setattr(jax.numpy, "ravel",
+                            lambda a: (fenced.append(a), real_ravel(a))[1])
+        profiling._barrier(leaves)
+        assert len(fenced) == len(leaves)
+
+    def test_barrier_ignores_host_values(self):
+        profiling._barrier((1, "x", None))    # nothing to fence, no raise
+
+
+class TestCaptureGuard:
+    def test_capture_trace_writes_and_returns_dir(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        d = profiling.capture_trace(0.05, str(tmp_path))
+        np.asarray(jax.jit(lambda x: x + 1)(jnp.ones((4,))))
+        assert d.startswith(str(tmp_path))
+        assert os.path.isdir(d)
+
+    def test_concurrent_capture_is_refused(self, tmp_path):
+        import threading
+        import time as _time
+
+        started = threading.Event()
+        done = threading.Event()
+
+        def long_capture():
+            with profiling.trace(str(tmp_path / "a")):
+                started.set()
+                done.wait(5.0)
+
+        t = threading.Thread(target=long_capture, daemon=True)
+        t.start()
+        assert started.wait(5.0)
+        try:
+            assert profiling.capture_in_progress()
+            with pytest.raises(profiling.ProfilerBusy):
+                profiling.capture_trace(0.01, str(tmp_path / "b"))
+        finally:
+            done.set()
+            t.join(5.0)
+        # guard released: a new capture works again
+        profiling.capture_trace(0.01, str(tmp_path / "c"))
+
+    def test_capture_rejects_bad_seconds(self):
+        with pytest.raises(ValueError):
+            profiling.capture_trace(0)
+        with pytest.raises(ValueError):
+            profiling.capture_trace(10_000)
+
+
+class TestProfileStepsEnv:
+    def test_unset_is_none(self, monkeypatch):
+        monkeypatch.delenv("DL4JTPU_PROFILE_STEPS", raising=False)
+        assert profiling.profile_steps_env() is None
+
+    def test_parses_range_and_dir(self, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_PROFILE_STEPS", "2:5:/tmp/prof")
+        assert profiling.profile_steps_env() == (2, 5, "/tmp/prof")
+        monkeypatch.setenv("DL4JTPU_PROFILE_STEPS", "0:3")
+        assert profiling.profile_steps_env() == (0, 3, None)
+
+    def test_rejects_malformed(self, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_PROFILE_STEPS", "5")
+        with pytest.raises(ValueError):
+            profiling.profile_steps_env()
+        monkeypatch.setenv("DL4JTPU_PROFILE_STEPS", "4:2")
+        with pytest.raises(ValueError):
+            profiling.profile_steps_env()
