@@ -12,8 +12,8 @@ from .lenet import lenet
 from .resnet import resnet, resnet50
 from .char_rnn import char_rnn_lstm
 from .classic import alexnet, deep_autoencoder, vgg16
-from .transformer import generate, transformer_lm
+from .transformer import draft_transformer_lm, generate, transformer_lm
 
 __all__ = ["lenet", "resnet", "resnet50", "char_rnn_lstm",
            "alexnet", "vgg16", "deep_autoencoder", "transformer_lm",
-           "generate"]
+           "draft_transformer_lm", "generate"]

@@ -142,27 +142,56 @@ def attention_vertices(net) -> List[str]:
     return names
 
 
-def sample_token(probs, temperature: float = 0.0, rng=None) -> int:
+def filtered_probs_host(p: np.ndarray, temperature: float, top_k: int,
+                        top_p: float) -> np.ndarray:
+    """Host mirror of ``ops.sampling.filtered_probs`` for ONE row — the
+    same temperature → top-k → renormalize → top-p → renormalize order,
+    the same stable lower-id tie-breaking (documented in
+    ``ops/sampling.py``; the host/device parity suite pins the pair)."""
+    logits = np.log(np.maximum(p, 1e-30)) / float(temperature)
+    logits -= logits.max()
+    w = np.exp(logits)
+    order = np.argsort(-w, kind="stable")
+    if top_k and top_k > 0:
+        w[order[int(top_k):]] = 0.0
+    w /= max(w.sum(), 1e-30)
+    if 0.0 < top_p < 1.0:
+        w_desc = w[order]
+        before = np.cumsum(w_desc) - w_desc
+        w[order[before >= top_p]] = 0.0
+        w /= max(w.sum(), 1e-30)
+    return w
+
+
+def sample_token(probs, temperature: float = 0.0, rng=None, *,
+                 top_k: int = 0, top_p: float = 1.0) -> int:
     """Next-token choice from a softmax row — host-side, shared by the
     full-cache oracle (:func:`generate`) and the paged serving engine so
     the two paths CANNOT diverge in how they read the same distribution.
-    ``temperature <= 0`` is greedy (argmax); otherwise softmax sampling at
-    the given temperature from ``rng`` (a ``numpy.random.Generator``)."""
+    ``temperature <= 0`` is greedy (argmax); otherwise an inverse-CDF
+    draw (one uniform from ``rng``, a ``numpy.random.Generator``) over
+    the temperature/top-k/top-p filtered distribution — the EXACT
+    semantics of the on-device sampler ``ops.sampling.sample_tokens``
+    (same filter order, same ascending-id inverse CDF), so host and
+    device agree token-for-token at the same uniform."""
     p = np.asarray(probs, dtype=np.float64).reshape(-1)
     if temperature <= 0.0:
         return int(np.argmax(p))
     if rng is None:
         raise ValueError("temperature sampling needs an rng")
-    logits = np.log(np.maximum(p, 1e-30)) / float(temperature)
-    logits -= logits.max()
-    e = np.exp(logits)
-    e /= e.sum()
-    return int(rng.choice(len(e), p=e))
+    w = filtered_probs_host(p, temperature, top_k, top_p)
+    c = np.cumsum(w)
+    gt = c > float(rng.random()) * c[-1]
+    if gt.any():
+        return int(np.argmax(gt))
+    # u·total reached the top of the CDF (possible only through float
+    # rounding): same last-positive-weight fallback as the device twin
+    return int(np.max(np.nonzero(w > 0)[0]))
 
 
 def generate(net, prompt_ids, max_new_tokens: int, *,
              temperature: float = 0.0, eos_id: Optional[int] = None,
-             rng=None) -> np.ndarray:
+             rng=None, top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
     """Single-sequence full-cache autoregressive decode through the
     streaming ``rnn_time_step`` path — the offline API AND the parity
     oracle the continuous-batching serving engine is pinned bit-exact
@@ -194,7 +223,7 @@ def generate(net, prompt_ids, max_new_tokens: int, *,
     probs = np.asarray(out)[0, -1]
     toks: List[int] = []
     for i in range(int(max_new_tokens)):
-        t = sample_token(probs, temperature, rng)
+        t = sample_token(probs, temperature, rng, top_k=top_k, top_p=top_p)
         toks.append(t)
         if (eos_id is not None and t == eos_id) \
                 or i == int(max_new_tokens) - 1:
@@ -242,3 +271,256 @@ def paged_decode_forward(net, params, k_pools, v_pools, ids, page_tables,
                                        minibatch=mbs[in_names[0]])
         acts[name] = out
     return acts[net.conf.network_outputs[0]], k_pools, v_pools
+
+
+# --------------------------------------------------------------------------
+# fused multi-token decode + speculative draft/verify (traced bodies)
+# --------------------------------------------------------------------------
+
+
+def draft_transformer_lm(vocab_size: int, *, d_model: int = 128,
+                         n_heads: int = 4, d_ff: int = 512,
+                         seed: int = 42, dtype: str = "float32",
+                         max_cache_t: Optional[int] = None):
+    """The in-tree DRAFT model family for speculative decoding: a
+    2-layer ids-mode :func:`transformer_lm` over the SAME vocabulary as
+    the target it drafts for (same input contract, same softmax head, so
+    its filtered distributions are directly comparable in the
+    accept/reject step). Train it however the target was trained — the
+    serving engine only requires matching vocab + window."""
+    return transformer_lm(vocab_size, n_layers=2, d_model=d_model,
+                          n_heads=n_heads, d_ff=d_ff, seed=seed,
+                          dtype=dtype, input_ids=True,
+                          max_cache_t=max_cache_t)
+
+
+def fused_decode_loop(net, params, k_pools, v_pools, last_tokens,
+                      page_tables, rel_pos, active, budget, eos_ids,
+                      temperature, top_k, top_p, uniforms):
+    """N decode steps over the paged arena in ONE dispatch — the
+    device-resident inner loop the serving engine jits per lane bucket
+    (``uniforms [S, N]`` fixes N at trace time). Each inner step
+    writes the lane's pending token's K/V (paged scatter), runs one
+    paged forward (t_new=1, identical math to the host-ticked step, so
+    greedy output stays bit-exact vs :func:`generate`), samples the next
+    token ON DEVICE (``ops.sampling.sample_tokens``: greedy argmax or
+    temperature/top-k/top-p inverse-CDF at that step's uniform), and
+    folds the EOS/budget self-retire mask: a finished lane keeps
+    computing (fixed shapes) but its writes turn to ``-1`` slots —
+    dropped by the scatter, same sentinel discipline as padded lanes —
+    and its outputs are marked invalid.
+
+    last_tokens ``[S]``: each lane's pending (sampled-but-unwritten)
+    token; rel_pos ``[S]``: its view-relative slot (the host pre-draws /
+    pre-rotates pages for the WHOLE block, so slots advance contiguously
+    ``rel_pos .. rel_pos+N-1``); active ``[S]``: padded lanes start
+    retired; budget ``[S]``: tokens this lane may still emit (≤ N);
+    eos_ids ``[S]`` (-1 = none); temperature/top_k/top_p ``[S]``
+    per-lane sampling config.
+
+    Returns ``(tokens [S, N], valid [S, N], n_emitted [S], done [S],
+    k_pools, v_pools)`` — ``valid`` is a prefix mask; ``n_emitted`` is
+    both the number of valid tokens AND the number of K/V slots the lane
+    actually wrote (the host advances its position by exactly this).
+
+    Two CPU-harness-measured costs shape the implementation: the loop
+    is a ``while_loop`` (not ``scan``) so a block whose every lane
+    self-retired stops computing instead of burning the remaining
+    steps, and the filtered-sampling pipeline (two vocab argsorts per
+    step) sits behind a ``lax.cond`` on "any lane sampling" — an
+    all-greedy block (the common serving case) pays only the argmax."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import sampling as _sampling
+
+    n_steps = uniforms.shape[1]
+    s = last_tokens.shape[0]
+    any_sampled = jnp.any(temperature > 0)
+
+    def pick(row, u):
+        return jax.lax.cond(
+            any_sampled,
+            lambda: _sampling.sample_tokens(row, temperature, top_k,
+                                            top_p, u),
+            lambda: jnp.argmax(row, axis=-1).astype(jnp.int32))
+
+    def cond_fn(st):
+        i, _, _, _, done, _, _, _ = st
+        return (i < n_steps) & jnp.logical_not(jnp.all(done))
+
+    def body_fn(st):
+        i, k_pools, v_pools, cur, done, n_emitted, toks, valid = st
+        slot = jnp.where(done, jnp.int32(-1), rel_pos + i)
+        probs, k_pools, v_pools = paged_decode_forward(
+            net, params, k_pools, v_pools, cur[:, None], page_tables,
+            slot[:, None], rel_pos + i)
+        u = jax.lax.dynamic_index_in_dim(uniforms, i, axis=1,
+                                         keepdims=False)
+        tok = pick(probs[:, 0, :], u)
+        emit = jnp.logical_not(done)
+        n_emitted = n_emitted + emit.astype(jnp.int32)
+        hit_eos = (eos_ids >= 0) & (tok == eos_ids)
+        done = done | (emit & (hit_eos | (n_emitted >= budget)))
+        cur = jnp.where(emit, tok, cur)
+        toks = jax.lax.dynamic_update_index_in_dim(
+            toks, jnp.where(emit, tok, -1), i, axis=1)
+        valid = jax.lax.dynamic_update_index_in_dim(valid, emit, i,
+                                                    axis=1)
+        return (i + 1, k_pools, v_pools, cur, done, n_emitted, toks,
+                valid)
+
+    st = (jnp.int32(0), list(k_pools), list(v_pools),
+          last_tokens.astype(jnp.int32), jnp.logical_not(active),
+          jnp.zeros(s, jnp.int32), jnp.full((s, n_steps), -1, jnp.int32),
+          jnp.zeros((s, n_steps), bool))
+    (_, k_pools, v_pools, _, done, n_emitted, toks,
+     valid) = jax.lax.while_loop(cond_fn, body_fn, st)
+    return toks, valid, n_emitted, done, k_pools, v_pools
+
+
+def draft_decode_loop(net, params, k_pools, v_pools, last_tokens,
+                      page_tables, rel_pos, active, write_budget,
+                      temperature, top_k, top_p, uniforms):
+    """The draft half of a speculative block: K+1 fused steps of the
+    (small) draft net over ITS OWN pools through the SHARED page tables.
+    ``uniforms`` is ``[S, K+1]``; the scan feeds
+    ``[pending, d_1 .. d_K]`` — K+1 inputs — so the draft writes K/V for
+    ALL of them, including ``d_K`` (whose output is discarded). That
+    last write is what keeps the draft cache gap-free after a
+    fully-accepted block: target and draft frontiers always advance in
+    lockstep, and rejected tokens' stale K/V sits beyond the causal mask
+    until legitimately overwritten (the same discipline as the fused
+    loop's dropped writes).
+
+    ``write_budget [S]`` caps each lane's writes at the tokens it can
+    still legitimately emit: slots past ``rel_pos + write_budget - 1``
+    are dropped. Without the cap a lane near its max-tokens (or near
+    the window edge) would scatter up to K useless slots past its last
+    possible position — forcing page draws (and, at the window edge,
+    PREMATURE EVICTION that would break within-window bit-exactness)
+    for tokens that can never exist. Draft outputs past the budget are
+    garbage-in-garbage-out: the host truncates to the budget anyway,
+    and every position the host can keep attends only to written slots.
+
+    Returns ``(draft_tokens [S, K], draft_dists [S, K, V], k_pools,
+    v_pools)`` — ``draft_dists`` are the FILTERED distributions the
+    draft sampled from (what the accept/reject ratio needs); greedy
+    lanes ignore them."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import sampling as _sampling
+
+    k1 = uniforms.shape[1]                  # K + 1
+    any_sampled = jnp.any(temperature > 0)
+
+    def body(carry, xs):
+        k_pools, v_pools, cur = carry
+        i, u = xs
+        slot = jnp.where(active & (i < write_budget), rel_pos + i,
+                         jnp.int32(-1))
+        probs, k_pools, v_pools = paged_decode_forward(
+            net, params, k_pools, v_pools, cur[:, None], page_tables,
+            slot[:, None], rel_pos + i)
+        row = probs[:, 0, :]
+        greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+        # all-greedy batches skip the filter pipeline AND its dist
+        # output (the verify greedy branch never reads it)
+        dist, tok = jax.lax.cond(
+            any_sampled,
+            lambda: (lambda d: (d, jnp.where(
+                temperature > 0, _sampling.inverse_cdf(d, u), greedy))
+            )(_sampling.filtered_probs(row, temperature, top_k, top_p)),
+            lambda: (jnp.zeros_like(row), greedy))
+        return (k_pools, v_pools, tok), (tok, dist)
+
+    init = (list(k_pools), list(v_pools), last_tokens.astype(jnp.int32))
+    (k_pools, v_pools, _), (toks, dists) = jax.lax.scan(
+        body, init, (jnp.arange(k1, dtype=jnp.int32), uniforms.T))
+    return (toks[:k1 - 1].T, dists[:k1 - 1].transpose(1, 0, 2),
+            k_pools, v_pools)
+
+
+def spec_verify(net, params, k_pools, v_pools, last_tokens, page_tables,
+                rel_pos, active, write_budget, draft_tokens, draft_dists,
+                temperature, top_k, top_p, u_accept, u_fix):
+    """The verify half of a speculative block: ONE batched target pass
+    over ``[pending, d_1 .. d_K]`` (K+1 positions — the paged chunk
+    forward is bit-exact vs feeding them one at a time, which is what
+    makes greedy speculative output identical to target-only decode),
+    then accept/reject + bonus selection ON DEVICE (Leviathan et al.):
+
+    - greedy lanes (``temperature <= 0``): accept ``d_i`` iff it equals
+      the target argmax at its position; the first mismatch position
+      emits the target argmax instead; a fully-accepted block emits the
+      position-K argmax as the BONUS token;
+    - sampled lanes: accept ``d_i`` with probability
+      ``min(1, q(d_i)/p(d_i))`` (filtered target / filtered draft) at
+      ``u_accept[:, i]``; the first rejection samples from the residual
+      ``max(q - p, 0)`` (fallback to ``q`` when the residual has no
+      mass) at ``u_fix``; the bonus is a plain draw from the filtered
+      position-K target distribution.
+
+    Returns ``(emitted [S, K+1], valid [S, K+1], accepts [S], k_pools,
+    v_pools)``: ``valid[:, j] = j <= accepts`` (a lane always emits its
+    accepted prefix plus exactly one correction-or-bonus token); the
+    HOST applies per-request EOS/max-tokens truncation to the valid
+    prefix — each speculative block is one host tick anyway, so
+    self-retire masking buys nothing here, unlike the fused loop.
+    ``write_budget`` caps writes exactly as in
+    :func:`draft_decode_loop` (same rationale, same slots)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import sampling as _sampling
+
+    s, k = draft_tokens.shape
+    k1 = k + 1
+    ids = jnp.concatenate([last_tokens[:, None].astype(jnp.int32),
+                           draft_tokens.astype(jnp.int32)], axis=1)
+    offs = jnp.arange(k1, dtype=jnp.int32)[None, :]
+    wslots = jnp.where(active[:, None] & (offs < write_budget[:, None]),
+                       rel_pos[:, None] + offs, jnp.int32(-1))
+    probs, k_pools, v_pools = paged_decode_forward(
+        net, params, k_pools, v_pools, ids, page_tables, wslots, rel_pos)
+    v = probs.shape[-1]
+    t_hat = jnp.argmax(probs, axis=-1).astype(jnp.int32)      # [S, K+1]
+    greedy = temperature <= 0
+    acc_greedy = draft_tokens == t_hat[:, :k]
+
+    def sampled_ops():
+        rep = lambda a: jnp.repeat(a, k1, axis=0)             # noqa: E731
+        q = _sampling.filtered_probs(probs.reshape(s * k1, v),
+                                     rep(temperature), rep(top_k),
+                                     rep(top_p)).reshape(s, k1, v)
+        q_d = jnp.take_along_axis(q[:, :k, :], draft_tokens[:, :, None],
+                                  axis=-1)[..., 0]            # [S, K]
+        p_d = jnp.take_along_axis(draft_dists, draft_tokens[:, :, None],
+                                  axis=-1)[..., 0]
+        acc_sampled = u_accept < jnp.minimum(
+            q_d / jnp.maximum(p_d, 1e-30), 1.0)
+        resid = jnp.maximum(q[:, :k, :] - draft_dists, 0.0)
+        has_mass = jnp.sum(resid, axis=-1, keepdims=True) > 0
+        resid = jnp.where(has_mass, resid, q[:, :k, :])
+        fix_dist = jnp.concatenate([resid, q[:, k:, :]],
+                                   axis=1)                    # [S, K+1, V]
+        fix_sampled = _sampling.inverse_cdf(
+            fix_dist.reshape(s * k1, v),
+            u_fix.reshape(s * k1)).reshape(s, k1)
+        return (jnp.where(greedy[:, None], acc_greedy, acc_sampled),
+                jnp.where(greedy[:, None], t_hat, fix_sampled))
+
+    # all-greedy batches skip the filter/residual pipeline entirely
+    accept, fix = jax.lax.cond(jnp.any(temperature > 0), sampled_ops,
+                               lambda: (acc_greedy, t_hat))
+    accepts = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                      axis=1)                                 # [S] 0..K
+    fix_at_a = jnp.take_along_axis(fix, accepts[:, None], axis=1)
+    d_pad = jnp.concatenate([draft_tokens,
+                             jnp.zeros((s, 1), jnp.int32)], axis=1)
+    j = jnp.arange(k1, dtype=jnp.int32)[None, :]
+    emitted = jnp.where(j < accepts[:, None], d_pad,
+                        jnp.where(j == accepts[:, None], fix_at_a, -1))
+    valid = (j <= accepts[:, None]) & active[:, None]
+    return emitted, valid, accepts, k_pools, v_pools

@@ -1,0 +1,107 @@
+"""On-device token sampling for the fused decode loop (pure XLA).
+
+One documented sampling semantics, shared with the HOST-side
+``models.transformer.sample_token`` so the ticked scheduler path, the
+full-cache oracle (``generate``) and the fused on-device loop CANNOT
+drift apart (the seeded host-vs-device parity suite in
+``tests/test_fused_decode.py`` pins it):
+
+1. **temperature** ``T > 0``: reweight ``w ∝ p^(1/T)`` (equivalently
+   softmax of ``log p / T``); ``T <= 0`` is greedy argmax of the RAW
+   distribution (no filtering — ties break toward the lower token id on
+   both host and device).
+2. **top-k** (``top_k > 0``): keep the ``top_k`` highest-weight tokens
+   — ties broken toward the lower token id via a stable descending sort
+   — zero the rest, renormalize.
+3. **top-p** (``0 < top_p < 1``): over the top-k-renormalized weights in
+   descending order, keep the minimal prefix whose cumulative mass
+   reaches ``top_p`` (a token is kept iff the mass BEFORE it is
+   ``< top_p``, so at least one survives), zero the rest, renormalize.
+4. **draw**: inverse-CDF over token ids in ASCENDING id order — the
+   sampled token is the smallest id whose cumulative weight exceeds
+   ``u·total`` (scaling by the total makes the draw robust to the
+   cumsum not closing exactly at 1.0 in floating point).
+
+The uniforms ``u`` are an ARGUMENT, not generated here: the serving
+scheduler draws them host-side from each request's seeded
+``numpy.random.Generator`` (N per lane per fused block), which keeps
+per-request reproducibility independent of batch composition AND makes
+host/device parity directly testable — feed both the same ``u``.
+
+Everything is vectorized over lanes with PER-LANE ``temperature`` /
+``top_k`` / ``top_p`` arrays, so one fused trace serves heterogeneous
+sampling configs without retracing (greedy lanes ride the same dispatch
+as sampled ones; the ``where`` on temperature picks the branch).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["filtered_probs", "inverse_cdf", "sample_tokens"]
+
+
+def filtered_probs(probs, temperature, top_k, top_p):
+    """Temperature/top-k/top-p filtered, renormalized distribution.
+
+    probs: ``[S, V]`` softmax rows; temperature/top_k/top_p: ``[S]``
+    per-lane (``top_k <= 0`` = no k-filter, ``top_p <= 0`` or ``>= 1`` =
+    no p-filter; ``temperature <= 0`` lanes are reweighted at T=1 — their
+    callers take the greedy branch and never read this). Returns
+    ``[S, V]`` float32 summing to ~1 per lane.
+    """
+    p = probs.astype(jnp.float32)
+    v = p.shape[-1]
+    t = jnp.where(temperature > 0, temperature, 1.0).astype(jnp.float32)
+    logits = jnp.log(jnp.maximum(p, 1e-30)) / t[:, None]
+    logits = logits - jnp.max(logits, axis=-1, keepdims=True)
+    w = jnp.exp(logits)
+    # descending stable order: rank r of a token = how many tokens beat
+    # it (ties toward the lower id — jax sorts are stable)
+    order = jnp.argsort(-w, axis=-1)                  # [S, V] ids, desc
+    ranks = jnp.argsort(order, axis=-1)               # [S, V] rank per id
+    k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)
+    w = jnp.where(ranks < k[:, None], w, 0.0)
+    w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-30)
+    # nucleus over the k-filtered dist: keep while the mass BEFORE the
+    # token is < top_p (position 0 always kept)
+    w_desc = jnp.take_along_axis(w, order, axis=-1)
+    before = jnp.cumsum(w_desc, axis=-1) - w_desc
+    tp = jnp.where((top_p > 0) & (top_p < 1), top_p, 1.0)
+    tp = tp.astype(jnp.float32)
+    keep_desc = before < tp[:, None]
+    keep = jnp.take_along_axis(keep_desc, ranks, axis=-1)
+    w = jnp.where(keep, w, 0.0)
+    return w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-30)
+
+
+def inverse_cdf(weights, u):
+    """Inverse-CDF draw in ascending token-id order.
+
+    weights: ``[S, V]`` nonnegative (need not be normalized — ``u`` is
+    scaled by each row's total); u: ``[S]`` in [0, 1). Returns ``[S]``
+    int32: the smallest id whose cumulative weight exceeds ``u·total``.
+    When ``u·total`` reaches the top of the CDF in floating point (a
+    host-float64 uniform within 2⁻²⁶ of 1 rounds to 1.0f), the draw
+    falls back to the LAST positive-weight id — never a filtered-out
+    token, which a bare argmax-over-all-False would return (id 0).
+    """
+    w = weights.astype(jnp.float32)
+    c = jnp.cumsum(w, axis=-1)
+    gt = c > (u.astype(jnp.float32) * c[:, -1])[:, None]
+    v = w.shape[-1]
+    last_pos = (v - 1) - jnp.argmax((w > 0)[:, ::-1], axis=-1)
+    return jnp.where(jnp.any(gt, axis=-1), jnp.argmax(gt, axis=-1),
+                     last_pos).astype(jnp.int32)
+
+
+def sample_tokens(probs, temperature, top_k, top_p, u):
+    """Per-lane next-token choice (the device twin of the host
+    ``models.transformer.sample_token``): greedy argmax where
+    ``temperature <= 0``, else inverse-CDF at ``u`` over the filtered
+    distribution. probs ``[S, V]``, everything else ``[S]`` → ``[S]``
+    int32."""
+    greedy = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    sampled = inverse_cdf(filtered_probs(probs, temperature, top_k, top_p),
+                          u)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -27,6 +27,32 @@ Two layers:
   decode-aware ``drain()``, and a ``fence()`` that holds the loop at a
   step boundary (mid-decode model swaps are refused through it).
 
+Three decode-step shapes (ISSUE 11 — the host-tick headroom PERF.md r9
+measured is the thing being removed):
+
+- ``block_len=1`` (default): the PR-6 host-ticked step — one dispatch,
+  one host round-trip per generated token.
+- ``block_len=N``: the FUSED loop — ``models.transformer.
+  fused_decode_loop`` runs N decode steps (paged scatter, forward,
+  on-device sampling, EOS/max-tokens self-retire mask) inside one
+  ``lax.while_loop`` dispatch (early exit once every lane retires);
+  the scheduler ticks once per block, so host
+  bookkeeping amortizes N× and ``decode_host_syncs_total`` grows by 1
+  per block instead of per token. N is bucketed to a power of two
+  (``util.xla.pow2_bucket``, cap 64) so the trace ladder gains exactly
+  one block-length axis.
+- ``draft_net=``: SPECULATIVE decoding on top — a small draft model
+  (same ``transformer_lm`` family, pools-only shadow arena indexed by
+  the SAME page tables) drafts ``draft_k`` tokens per lane in one fused
+  scan, the target verifies all of them in one batched K+1 chunk, and
+  accept/reject + bonus selection happen on device (Leviathan et al.);
+  a block emits 1..K+1 tokens for two dispatches and ONE host sync.
+
+Greedy output through all three is bit-exact against the oracle (the
+per-step math is identical; the verify chunk equals sequential feeding
+the same way multi-chunk prefill does) — ``tests/test_fused_decode.py``
+pins fused == ticked == oracle and speculative == target-only.
+
 Greedy output through this path is BIT-EXACT against the single-sequence
 full-cache oracle (``models.transformer.generate``) for every sequence
 that stays within the window (prompt + generated ≤ page_size ×
@@ -94,15 +120,19 @@ class DecodeRequest:
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "eos_id",
                  "deadline", "rng", "tokens", "finish_reason", "error",
-                 "event", "t_submit", "t_first_token", "t_done")
+                 "event", "t_submit", "t_first_token", "t_done",
+                 "top_k", "top_p")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float, eos_id: Optional[int],
-                 deadline: Deadline, rng, t_submit: float):
+                 deadline: Deadline, rng, t_submit: float,
+                 top_k: int = 0, top_p: float = 1.0):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
         self.deadline = deadline
         self.rng = rng
         self.tokens: List[int] = []
@@ -151,7 +181,8 @@ class PagedDecodeEngine:
     def __init__(self, net, *, max_batch: int = 8, page_size: int = 16,
                  pages_per_seq: int = 8, num_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 registry: Optional[_metrics.MetricsRegistry] = None):
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 block_len: int = 1, draft_net=None, draft_k: int = 4):
         import jax.numpy as jnp
         self._validate_net(net)
         self.net = net
@@ -171,6 +202,14 @@ class PagedDecodeEngine:
             raise ValueError(
                 f"prefill_chunk={self.prefill_chunk} must be in "
                 f"[1, window={self.window}]")
+        # fused-block length: bucketed to a power of two (cap 64) so the
+        # trace ladder's block axis is a FIXED set however callers
+        # configure it; 1 = the host-ticked step
+        self.block_len = _xla.pow2_bucket(int(block_len), cap=64)
+        if self.block_len > self.window:
+            raise ValueError(
+                f"block_len={self.block_len} exceeds the window "
+                f"({self.window}) — a block must fit the lane's view")
         self.registry = registry if registry is not None \
             else _metrics.MetricsRegistry()
         self._check_decode_config(net)
@@ -185,6 +224,42 @@ class PagedDecodeEngine:
         self.arena = PagedKVArena(dims, num_pages=int(num_pages),
                                   page_size=self.page_size, dtype=dtype,
                                   registry=self.registry)
+        self.vocab = self._embed_vocab(net)
+        # speculative decoding: the draft model's K/V lives in a
+        # pools-only SHADOW arena indexed by the same page tables (one
+        # admission/eviction decision covers both models)
+        self.draft_net = draft_net
+        self.draft_k = int(draft_k)
+        self.draft_arena = None
+        if draft_net is not None:
+            if int(block_len) != 1:
+                raise ValueError(
+                    "block_len and draft_net are mutually exclusive — "
+                    "speculative blocks are draft_k-sized; configure one "
+                    "decode-step shape")
+            if not (1 <= self.draft_k <= 16):
+                raise ValueError(
+                    f"draft_k={self.draft_k} out of range [1, 16]")
+            if self.draft_k + 1 > self.window:
+                raise ValueError(
+                    f"draft_k={self.draft_k}+1 exceeds the window "
+                    f"({self.window})")
+            self._validate_net(draft_net)
+            self._check_decode_config(draft_net)
+            if self._embed_vocab(draft_net) != self.vocab:
+                raise ValueError(
+                    f"draft vocab {self._embed_vocab(draft_net)} != "
+                    f"target vocab {self.vocab} — accept/reject compares "
+                    "distributions over one vocabulary")
+            ddims = {}
+            for name in _transformer.attention_vertices(draft_net):
+                layer = draft_net.conf.vertices[name].layer
+                ddims[name] = (layer.n_heads, layer.n_in // layer.n_heads)
+            ddtype = jnp.promote_types(draft_net.policy.compute_dtype,
+                                       jnp.float32)
+            self.draft_arena = PagedKVArena(
+                ddims, num_pages=int(num_pages), page_size=self.page_size,
+                dtype=ddtype, with_allocator=False)
         # per-lane host state
         s, p = self.lanes, self.pages_per_seq
         self._tables = np.full((s, p), self.arena.sentinel, np.int32)
@@ -194,7 +269,26 @@ class PagedDecodeEngine:
         self._reserve_left = np.zeros(s, np.int64)
         self._free_lanes = deque(range(s))
         self._jit_cache: Dict[str, object] = {}
-        self.vocab = self._embed_vocab(net)
+        # host-round-trip accounting (the satellite the fused loop is
+        # measured by): every dispatch that synchronizes the host bumps
+        # the sync counter and lands in the "dispatch" component of the
+        # tick histogram; the scheduler observes the remainder of its
+        # tick as "bookkeeping"
+        self._m_syncs = self.registry.counter(
+            "decode_host_syncs_total",
+            "Decode dispatches whose results the host synchronized on")
+        self._m_dispatches = self.registry.counter(
+            "decode_dispatches_total",
+            "Device dispatches issued by the decode engine", ("kind",))
+        self._m_tick = self.registry.histogram(
+            "decode_host_tick_seconds",
+            "Scheduler tick wall split into dispatch (device compute + "
+            "sync) vs host bookkeeping components", ("component",),
+            buckets=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1, 0.25, 1.0])
+        self._tick_dispatch_wall = 0.0
+        self._tick_dispatches = 0
+        self._warming = False
 
     # -- construction-time validation ---------------------------------
 
@@ -367,39 +461,221 @@ class PagedDecodeEngine:
                 self.net, params, k_pools, v_pools, ids, tables, wslots,
                 rel)
 
+        (probs,) = self._dispatch(name, step, self.arena, self.net.params,
+                                  (ids, tables, write_slots, rel_pos),
+                                  kind="paged")
+        return probs
+
+    def _dispatch(self, name: str, step, arena, params, args: tuple, *,
+                  kind: str, sync: bool = True) -> list:
+        """The ONE copy of the jitted-dispatch protocol every decode
+        program goes through: jit ``step`` under the trace-ladder key
+        ``name``, call it with ``(params, arena.k_pools, arena.v_pools,
+        *args)`` donating the pools, store the returned pools back on
+        ``arena``, and account the dispatch. ``step`` must return
+        ``(*outputs, k_pools, v_pools)``. A failed dispatch rebuilds
+        EVERY arena before re-raising — the pools were donated and may
+        already be consumed; the scheduler retires the in-flight batch
+        and keeps serving on the fresh pools. ``sync=True`` transfers
+        the outputs to host (one host round-trip, counted); ``sync=
+        False`` returns them as device arrays (a later sync waits them
+        out)."""
         fn = _xla.keyed_jit(
             self._jit_cache, step, extra=name,
             wrap=lambda f: _xla.retrace_guard(f, name, self.registry),
             donate_argnums=(1, 2))
+        t0 = time.perf_counter()
         try:
-            probs, k_pools, v_pools = fn(
-                self.net.params, self.arena.k_pools, self.arena.v_pools,
-                ids, tables, write_slots, rel_pos)
+            *outputs, k_pools, v_pools = fn(
+                params, arena.k_pools, arena.v_pools, *args)
+            arena.k_pools = list(k_pools)
+            arena.v_pools = list(v_pools)
+            if sync:
+                # the sync lives INSIDE the try: on device backends an
+                # async kernel failure surfaces here, not at fn() — the
+                # rebuild must cover it or the errored pools just stored
+                # above would poison every later dispatch (this sync also
+                # surfaces failures from earlier sync=False dispatches)
+                outputs = [np.asarray(o) for o in outputs]
         except Exception:
-            # the pools were DONATED into the failed dispatch — on device
-            # backends they may already be consumed, so rebuild before
-            # re-raising (the scheduler retires the in-flight batch and
-            # keeps serving on the fresh arena)
-            self.arena.reset_pools()
+            self._reset_all_pools()
             raise
-        self.arena.k_pools = list(k_pools)
-        self.arena.v_pools = list(v_pools)
-        return np.asarray(probs)
+        self._note_dispatch(t0, kind, sync=sync)
+        return outputs
+
+    def _reset_all_pools(self) -> None:
+        self.arena.reset_pools()
+        if self.draft_arena is not None:
+            self.draft_arena.reset_pools()
+
+    def _note_dispatch(self, t0: float, kind: str,
+                       sync: bool = True) -> None:
+        if self._warming:
+            # warmup dispatches are compile calls — folding their
+            # multi-second walls into the steady-state tick histogram
+            # (or the sync/token ratio) would bury the signal the
+            # satellite metric exists to show
+            return
+        dt = time.perf_counter() - t0
+        self._tick_dispatch_wall += dt
+        self._tick_dispatches += 1
+        self._m_dispatches.inc(kind=kind)
+        if sync:
+            self._m_syncs.inc()
+            self._m_tick.observe(dt, component="dispatch")
+
+    # -- fused multi-token block --------------------------------------
+
+    def run_fused(self, last: np.ndarray, tables: np.ndarray,
+                  rel: np.ndarray, active: np.ndarray, budget: np.ndarray,
+                  eos: np.ndarray, temps: np.ndarray, top_k: np.ndarray,
+                  top_p: np.ndarray, uniforms: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One fused block: ``uniforms.shape[1]`` decode steps in ONE
+        dispatch through ``models.transformer.fused_decode_loop`` —
+        on-device sampling and EOS/budget self-retire included. One
+        host sync per block (the satellite ``decode_host_syncs_total``
+        measures). Returns host ``(tokens [B, N], valid [B, N],
+        n_emitted [B])``."""
+        b, n = uniforms.shape
+        name = f"fused_decode[S{b}xN{n}xP{self.pages_per_seq}]"
+
+        def step(params, k_pools, v_pools, last, tables, rel, active,
+                 budget, eos, temps, tk, tp, u):
+            return _transformer.fused_decode_loop(
+                self.net, params, k_pools, v_pools, last, tables, rel,
+                active, budget, eos, temps, tk, tp, u)
+
+        toks, valid, n_emitted, _done = self._dispatch(
+            name, step, self.arena, self.net.params,
+            (last, tables, rel, active, budget, eos, temps, top_k, top_p,
+             uniforms), kind="fused")
+        return toks, valid, n_emitted
+
+    # -- speculative draft / verify -----------------------------------
+
+    def run_draft_prefill(self, ids: np.ndarray, write_slots: np.ndarray,
+                          rel_pos: np.ndarray, tables: np.ndarray) -> None:
+        """Shadow prefill: the draft model processes the SAME prompt
+        chunk into its own pools (same tables, same slots), so its first
+        drafting block sees the full context. Output discarded — no host
+        sync; an async failure surfaces at the block's verify sync."""
+        b, t = ids.shape
+        name = f"draft_prefill[S{b}xT{t}xP{self.pages_per_seq}]"
+
+        def step(params, k_pools, v_pools, ids, tables, wslots, rel):
+            return _transformer.paged_decode_forward(
+                self.draft_net, params, k_pools, v_pools, ids, tables,
+                wslots, rel)
+
+        self._dispatch(name, step, self.draft_arena,
+                       self.draft_net.params,
+                       (ids, tables, write_slots, rel_pos),
+                       kind="draft_prefill", sync=False)
+
+    def run_draft(self, last: np.ndarray, tables: np.ndarray,
+                  rel: np.ndarray, active: np.ndarray,
+                  write_budget: np.ndarray, temps: np.ndarray,
+                  top_k: np.ndarray, top_p: np.ndarray,
+                  uniforms: np.ndarray):
+        """Draft half of a speculative block: K+1 fused steps of the
+        draft net (``uniforms [B, K+1]``). Returns DEVICE arrays
+        ``(draft_tokens [B, K], draft_dists [B, K, V])`` — they feed
+        straight into :meth:`run_verify` with no host sync between."""
+        b, k1 = uniforms.shape
+        name = f"spec_draft[S{b}xK{k1 - 1}xP{self.pages_per_seq}]"
+
+        def step(params, k_pools, v_pools, last, tables, rel, active,
+                 wbudget, temps, tk, tp, u):
+            return _transformer.draft_decode_loop(
+                self.draft_net, params, k_pools, v_pools, last, tables,
+                rel, active, wbudget, temps, tk, tp, u)
+
+        d_toks, d_dists = self._dispatch(
+            name, step, self.draft_arena, self.draft_net.params,
+            (last, tables, rel, active, write_budget, temps, top_k,
+             top_p, uniforms), kind="draft", sync=False)
+        return d_toks, d_dists
+
+    def run_verify(self, last: np.ndarray, tables: np.ndarray,
+                   rel: np.ndarray, active: np.ndarray,
+                   write_budget: np.ndarray, d_toks, d_dists,
+                   temps: np.ndarray, top_k: np.ndarray,
+                   top_p: np.ndarray, u_accept: np.ndarray,
+                   u_fix: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Verify half: one batched K+1 target chunk + on-device
+        accept/reject/bonus (``models.transformer.spec_verify``). The
+        block's ONE host sync happens here (it also waits out the draft
+        dispatch). Returns host ``(emitted [B, K+1], valid [B, K+1],
+        accepts [B])``."""
+        b, k = u_accept.shape
+        name = f"spec_verify[S{b}xK{k}xP{self.pages_per_seq}]"
+
+        def step(params, k_pools, v_pools, last, tables, rel, active,
+                 wbudget, d_toks, d_dists, temps, tk, tp, ua, uf):
+            return _transformer.spec_verify(
+                self.net, params, k_pools, v_pools, last, tables, rel,
+                active, wbudget, d_toks, d_dists, temps, tk, tp, ua, uf)
+
+        emitted, valid, accepts = self._dispatch(
+            name, step, self.arena, self.net.params,
+            (last, tables, rel, active, write_budget, d_toks, d_dists,
+             temps, top_k, top_p, u_accept, u_fix), kind="verify")
+        return emitted, valid, accepts
 
     def warmup(self) -> None:
         """Compile the entire fixed trace set — every power-of-two lane
-        bucket × both chunk lengths — up front, so serving cold-start
-        pays compilation here instead of on the first live requests.
-        Warmup dispatches carry all-sentinel tables and dropped write
-        slots, so they cannot perturb the arena."""
+        bucket × the chunk/block shapes the configured mode actually
+        dispatches (prefill chunk always; the t=1 ticked step OR the
+        fused block OR the draft-prefill/draft/verify triple) — up
+        front, so serving cold-start pays compilation here instead of on
+        the first live requests. Warmup dispatches carry all-sentinel
+        tables and dropped write slots, so they cannot perturb the
+        arena."""
+        self._warming = True
+        try:
+            self._warmup_ladder()
+        finally:
+            self._warming = False
+
+    def _warmup_ladder(self) -> None:
         b = 1
         while True:
-            for t in (1, self.prefill_chunk):
-                self.run(np.zeros((b, t), np.int32),
-                         np.full((b, t), -1, np.int32),
-                         np.zeros(b, np.int32),
-                         np.full((b, self.pages_per_seq),
-                                 self.arena.sentinel, np.int32))
+            c = self.prefill_chunk
+            sentinel_tables = np.full((b, self.pages_per_seq),
+                                      self.arena.sentinel, np.int32)
+            self.run(np.zeros((b, c), np.int32),
+                     np.full((b, c), -1, np.int32),
+                     np.zeros(b, np.int32), sentinel_tables)
+            inactive = np.zeros(b, bool)
+            zeros_f = np.zeros(b, np.float32)
+            zeros_i = np.zeros(b, np.int32)
+            if self.draft_net is not None:
+                self.run_draft_prefill(np.zeros((b, c), np.int32),
+                                       np.full((b, c), -1, np.int32),
+                                       np.zeros(b, np.int32),
+                                       sentinel_tables)
+                d_toks, d_dists = self.run_draft(
+                    zeros_i, sentinel_tables, zeros_i, inactive, zeros_i,
+                    zeros_f, zeros_i, np.ones(b, np.float32),
+                    np.zeros((b, self.draft_k + 1), np.float32))
+                self.run_verify(
+                    zeros_i, sentinel_tables, zeros_i, inactive, zeros_i,
+                    d_toks, d_dists, zeros_f, zeros_i,
+                    np.ones(b, np.float32),
+                    np.zeros((b, self.draft_k), np.float32),
+                    np.zeros((b, self.draft_k + 1), np.float32))
+            elif self.block_len > 1:
+                self.run_fused(
+                    zeros_i, sentinel_tables, zeros_i, inactive, zeros_i,
+                    np.full(b, -1, np.int32), zeros_f, zeros_i,
+                    np.ones(b, np.float32),
+                    np.zeros((b, self.block_len), np.float32))
+            else:
+                self.run(np.zeros((b, 1), np.int32),
+                         np.full((b, 1), -1, np.int32),
+                         np.zeros(b, np.int32), sentinel_tables)
             if b >= self.lanes:
                 break
             b <<= 1           # same ladder _compact produces
@@ -501,6 +777,9 @@ class DecodeScheduler:
             "Steady-state seconds per output token, per finished sequence",
             buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                      0.1, 0.25, 0.5, 1.0])
+        self._m_draft = reg.counter(
+            "decode_draft_tokens_total",
+            "Speculative draft tokens, by verify outcome", ("result",))
         # weakly bound, like the arena gauges: a retired scheduler (and
         # through it the engine, params, and pools) must stay
         # collectable even on a shared registry — a dead ref raises,
@@ -529,11 +808,14 @@ class DecodeScheduler:
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None, *,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                timeout_s: Optional[float] = None,
-               seed: Optional[int] = None) -> DecodeRequest:
+               seed: Optional[int] = None, top_k: int = 0,
+               top_p: float = 1.0) -> DecodeRequest:
         """Accept one generative request into the bounded queue. Raises
         :class:`SchedulerDraining` / :class:`SchedulerSaturated` (the
         shed paths — recorded by reason) instead of queueing unbounded
-        latency."""
+        latency. ``top_k``/``top_p`` filter temperature sampling (the
+        one semantics shared by the host sampler and the fused device
+        loop — see ``ops/sampling.py``); ignored when greedy."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -545,12 +827,24 @@ class DecodeScheduler:
                     else self.default_max_new_tokens)
         if n_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if int(top_k) < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not (0.0 < float(top_p) <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # top_k >= vocab filters nothing — normalize to 0 so the value
+        # stays int32-safe in the device block arrays (an unbounded
+        # client value would OverflowError inside the tick and
+        # error-retire every in-flight sequence)
+        top_k = int(top_k)
+        if self.engine.vocab and top_k >= self.engine.vocab:
+            top_k = 0
         rng = (np.random.default_rng(seed) if temperature > 0 else None)
         req = DecodeRequest(
             prompt, n_new, temperature, eos_id,
             Deadline(timeout_s if timeout_s is not None
                      else self.request_timeout_s, self.clock),
-            rng, self.clock.monotonic())
+            rng, self.clock.monotonic(), top_k=int(top_k),
+            top_p=float(top_p))
         with self._cond:
             # flags checked under the lock: a submit racing stop() must
             # either land before the shutdown flush or be refused — never
@@ -578,6 +872,10 @@ class DecodeScheduler:
         the scheduler serving (the arena's masks make recycled pages
         safe for the next admissions)."""
         with self._dispatch_lock:
+            eng = self.engine
+            t_tick = time.perf_counter()
+            eng._tick_dispatch_wall = 0.0
+            eng._tick_dispatches = 0
             progressed = self._retire_expired()
             progressed = self._admit() or progressed
             try:
@@ -591,6 +889,16 @@ class DecodeScheduler:
                     seq.req.error = f"{type(e).__name__}: {e}"
                     self._retire(seq, "error")
                 progressed = True
+            # the measured split behind the fused-block design: dispatch
+            # wall (device compute + sync, observed per dispatch by the
+            # engine) vs everything else this tick did on the host —
+            # only ticks that dispatched count, so idle polling doesn't
+            # flood the bookkeeping series
+            if eng._tick_dispatches:
+                total = time.perf_counter() - t_tick
+                eng._m_tick.observe(
+                    max(0.0, total - eng._tick_dispatch_wall),
+                    component="bookkeeping")
             return progressed
 
     def _retire_expired(self) -> bool:
@@ -673,6 +981,11 @@ class DecodeScheduler:
         _faults.check("serving.decode_step",
                       {"phase": "prefill", "lanes": len(seqs)})
         probs = eng.run(ids, wslots, rel, tables)   # [B, C, V]
+        if eng.draft_net is not None:
+            # shadow prefill: the draft cache must hold the same prompt
+            # context before its first drafting block (same ids, same
+            # slots, its own pools)
+            eng.run_draft_prefill(ids, wslots, rel, tables)
         self._m_tokens.inc(sum(chunk_len), phase="prefill")
         for i, seq in enumerate(seqs):
             n = chunk_len[i]
@@ -691,6 +1004,10 @@ class DecodeScheduler:
         if not seqs:
             return False
         eng = self.engine
+        if eng.draft_net is not None:
+            return self._spec_block_tick(seqs)
+        if eng.block_len > 1:
+            return self._fused_block_tick(seqs)
         for seq in seqs:
             eng.ensure_pages(seq.lane, 1)
         ids, wslots, rel, tables = self._compact(seqs, 1)
@@ -716,13 +1033,170 @@ class DecodeScheduler:
                              greedy_tok=int(greedy[i]))
         return True
 
+    def _block_arrays(self, seqs: List[_Sequence], n_uniform: int):
+        """Per-lane arrays for a fused/speculative block over a
+        power-of-two bucket: pending token, view-relative position,
+        active mask (padded lanes start retired), per-lane sampling
+        config, and ``n_uniform`` host-drawn uniforms per sampled lane
+        (from each request's seeded rng — per-request reproducibility is
+        independent of batch composition)."""
+        eng = self.engine
+        b = 1
+        while b < len(seqs):
+            b <<= 1
+        arr = {
+            "last": np.zeros(b, np.int32),
+            "rel": np.zeros(b, np.int32),
+            "active": np.zeros(b, bool),
+            "eos": np.full(b, -1, np.int32),
+            "temps": np.zeros(b, np.float32),
+            "top_k": np.zeros(b, np.int32),
+            "top_p": np.ones(b, np.float32),
+            "u": np.zeros((b, n_uniform), np.float32),
+            "tables": np.full((b, eng.pages_per_seq), eng.arena.sentinel,
+                              np.int32),
+        }
+        for i, seq in enumerate(seqs):
+            req = seq.req
+            arr["tables"][i] = eng._tables[seq.lane]
+            arr["last"][i] = seq.last_token
+            arr["rel"][i] = eng.rel_pos(seq.lane)
+            arr["active"][i] = True
+            if req.eos_id is not None:
+                arr["eos"][i] = req.eos_id
+            if req.temperature > 0:
+                arr["temps"][i] = req.temperature
+                arr["top_k"][i] = req.top_k
+                arr["top_p"][i] = req.top_p
+                arr["u"][i] = req.rng.random(n_uniform)
+        return arr
+
+    def _fused_block_tick(self, seqs: List[_Sequence]) -> bool:
+        """One FUSED block: N device-resident decode steps, one
+        dispatch, one host sync — retire/admit happen at this block
+        boundary, finished lanes self-retired on device mid-block."""
+        eng = self.engine
+        n = eng.block_len
+        budgets = []
+        for seq in seqs:
+            remaining = seq.req.max_new_tokens - len(seq.req.tokens)
+            budgets.append(min(n, remaining))
+            eng.ensure_pages(seq.lane, budgets[-1])
+        a = self._block_arrays(seqs, n)
+        budget = np.zeros(a["last"].shape[0], np.int32)
+        budget[:len(seqs)] = budgets
+        _faults.check("serving.decode_step",
+                      {"phase": "decode_block", "lanes": len(seqs),
+                       "block_len": n})
+        toks, valid, n_emitted = eng.run_fused(
+            a["last"], a["tables"], a["rel"], a["active"], budget,
+            a["eos"], a["temps"], a["top_k"], a["top_p"], a["u"])
+        self._m_steps.inc()
+        self._m_occupancy.observe(float(len(seqs)))
+        emitted_total = 0
+        for i, seq in enumerate(seqs):
+            m = int(n_emitted[i])
+            eng.advance(seq.lane, m)
+            emitted_total += m
+            for j in range(m):
+                self._absorb_token(seq, int(toks[i, j]))
+                if seq.req.done:
+                    break
+        self._m_tokens.inc(emitted_total, phase="decode")
+        _flight.record("decode_block", kind="fused", lanes=len(seqs),
+                       block_len=n, tokens=emitted_total,
+                       active=len(self._active))
+        return True
+
+    def _spec_block_tick(self, seqs: List[_Sequence]) -> bool:
+        """One SPECULATIVE block: the draft scans K+1 steps, the target
+        verifies all K drafts in one batched chunk, accept/reject +
+        bonus land on device — 1..K+1 tokens per lane for two dispatches
+        and one host sync. EOS/max-tokens truncation of the valid prefix
+        is host-side (the block boundary is already a host tick)."""
+        eng = self.engine
+        k = eng.draft_k
+        # write budget = tokens the lane can still emit: slots past it
+        # are masked on device, so a lane near max-tokens (or the
+        # window edge) never draws pages — or worse, evicts live ones —
+        # for positions that cannot exist
+        wbudget = []
+        for seq in seqs:
+            remaining = seq.req.max_new_tokens - len(seq.req.tokens)
+            wbudget.append(min(k + 1, remaining))
+            eng.ensure_pages(seq.lane, wbudget[-1])
+        a = self._block_arrays(seqs, k + 1)
+        n_sampled = int(np.count_nonzero(a["temps"] > 0))
+        write_budget = np.zeros(a["last"].shape[0], np.int32)
+        write_budget[:len(seqs)] = wbudget
+        u_acc = np.zeros((a["last"].shape[0], k), np.float32)
+        u_fix = np.zeros((a["last"].shape[0], k + 1), np.float32)
+        for i, seq in enumerate(seqs):
+            if seq.req.temperature > 0:
+                u_acc[i] = seq.req.rng.random(k)
+                u_fix[i] = seq.req.rng.random(k + 1)
+        _faults.check("serving.decode_step",
+                      {"phase": "spec_block", "lanes": len(seqs),
+                       "draft_k": k})
+        d_toks, d_dists = eng.run_draft(
+            a["last"], a["tables"], a["rel"], a["active"], write_budget,
+            a["temps"], a["top_k"], a["top_p"], a["u"])
+        emitted, valid, accepts = eng.run_verify(
+            a["last"], a["tables"], a["rel"], a["active"], write_budget,
+            d_toks, d_dists, a["temps"], a["top_k"], a["top_p"], u_acc,
+            u_fix)
+        self._m_steps.inc()
+        self._m_occupancy.observe(float(len(seqs)))
+        emitted_total = 0
+        for i, seq in enumerate(seqs):
+            m = 0
+            for j in range(k + 1):
+                if not valid[i, j]:
+                    break
+                self._absorb_token(seq, int(emitted[i, j]))
+                m += 1
+                if seq.req.done:
+                    break
+            if not seq.req.done:
+                # a finished lane was already released by _absorb_token's
+                # retire — advancing it would stamp a phantom position
+                # onto a freed lane
+                eng.advance(seq.lane, m)
+            emitted_total += m
+            # acceptance accounting over drafts that had a CHANCE of
+            # being served (valid context within the write budget):
+            # accepted = drafts that became output; rejected = chanced
+            # drafts that went unserved — by target mismatch or because
+            # the lane finished first (both are wasted draft work, which
+            # is what the acceptance rate measures). Beyond-budget
+            # drafts are garbage by construction and count as neither.
+            chanced = min(k, wbudget[i])
+            served = min(int(accepts[i]), m, chanced)
+            self._m_draft.inc(served, result="accepted")
+            self._m_draft.inc(chanced - served, result="rejected")
+        self._m_tokens.inc(emitted_total, phase="decode")
+        _flight.record("decode_block", kind="speculative",
+                       lanes=len(seqs), draft_k=k, tokens=emitted_total,
+                       sampled_lanes=n_sampled, active=len(self._active))
+        return True
+
     def _emit_token(self, seq: _Sequence, probs: np.ndarray, *,
                     greedy_tok: Optional[int] = None) -> None:
         req = seq.req
         tok = (greedy_tok if greedy_tok is not None
                and req.temperature <= 0.0
                else _transformer.sample_token(probs, req.temperature,
-                                              req.rng))
+                                              req.rng, top_k=req.top_k,
+                                              top_p=req.top_p))
+        self._absorb_token(seq, tok)
+
+    def _absorb_token(self, seq: _Sequence, tok: int) -> None:
+        """Account one generated token (host-sampled by
+        :meth:`_emit_token`, or device-sampled inside a fused/spec
+        block): append, stamp TTFT, retire on EOS/max-tokens — the ONE
+        copy of the finish rules, so device self-retire decisions and
+        host bookkeeping cannot disagree."""
+        req = seq.req
         if req.t_first_token is None:
             req.t_first_token = self.clock.monotonic()
             self._m_ttft.observe(req.t_first_token - req.t_submit)

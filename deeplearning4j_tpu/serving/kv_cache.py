@@ -155,7 +155,15 @@ class PagedKVArena:
 
     def __init__(self, layer_dims: Dict[str, Tuple[int, int]], *,
                  num_pages: int, page_size: int, dtype=jnp.float32,
-                 registry: Optional[_metrics.MetricsRegistry] = None):
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 with_allocator: bool = True):
+        """``with_allocator=False`` builds a POOLS-ONLY shadow arena —
+        the speculative-decoding draft model's K/V lives in one of
+        these, indexed by the page tables the TARGET's allocator owns
+        (one admission/eviction decision covers both models). A shadow
+        arena must never allocate (``allocator`` is None) nor register
+        page gauges (they would shadow the owning arena's series on a
+        shared registry)."""
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if not layer_dims:
@@ -169,7 +177,8 @@ class PagedKVArena:
         self.k_pools: List[jnp.ndarray] = []
         self.v_pools: List[jnp.ndarray] = []
         self.reset_pools()
-        self.allocator = PageAllocator(num_pages, registry=registry)
+        self.allocator = (PageAllocator(num_pages, registry=registry)
+                          if with_allocator else None)
 
     def reset_pools(self) -> None:
         """Fresh zero pools. Used at construction AND after a failed

@@ -374,7 +374,9 @@ class InferenceServer:
                 temperature=float(payload.get("temperature", 0.0)),
                 eos_id=payload.get("eos_id"),
                 timeout_s=timeout_s,
-                seed=payload.get("seed"))
+                seed=payload.get("seed"),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 1.0)))
         except SchedulerDraining:
             return {"error": "server is draining"}, 503, 1.0
         except SchedulerSaturated as e:

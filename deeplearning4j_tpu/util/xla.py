@@ -78,6 +78,22 @@ def trace_env_key() -> str:
             f"|fabwd={os.environ.get('DL4JTPU_FLASH_BWD', 'pallas')}")
 
 
+def pow2_bucket(n: int, cap: int) -> int:
+    """Round ``n`` up to the next power of two, capped at ``cap`` (itself
+    a power of two): the shared rule for every trace-ladder axis (the
+    decode engine's lane buckets AND its fused block length), so any
+    requested size maps into a FIXED, enumerable trace set and
+    ``jit_retraces_total`` stays pinned however callers configure it."""
+    if n < 1:
+        raise ValueError(f"bucketed size must be >= 1, got {n}")
+    if cap < 1 or (cap & (cap - 1)):
+        raise ValueError(f"cap must be a power of two, got {cap}")
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
 def keyed_jit(cache: Dict[str, Any], fn: Callable, *, extra: str = "",
               wrap: Optional[Callable[[Callable], Callable]] = None,
               name: Optional[str] = None, registry=None, **jit_kw):

@@ -1,0 +1,556 @@
+"""Fused multi-token decode loop + speculative decoding (ISSUE 11).
+
+Contracts pinned here:
+
+1. **Shared sampling semantics**: the host ``sample_token`` (grown
+   top-k/top-p) and the device ``ops.sampling.sample_tokens`` agree
+   token-for-token at the same uniform — seeded parity sweep plus
+   hand-built filter-semantics cases.
+2. **Fused bit-exactness**: greedy decode through the N-step fused
+   ``lax.scan`` block — ragged lengths, mid-block EOS self-retire,
+   blocks straddling page boundaries, budget truncation — produces
+   EXACTLY the ticked scheduler's and the full-cache oracle's tokens.
+3. **Speculative bit-exactness**: greedy output through draft/verify
+   equals target-only greedy whatever the draft proposes (a perfect
+   draft accepts everything, a bad draft just accepts less), and the
+   acceptance-rate metric accounts every drafted token.
+4. **Trace ladder**: the block-length axis stays a fixed trace set —
+   ``jit_retraces_total`` pinned at 1 per (bucket, shape) under
+   admission/retirement churn, and ``warmup()`` precompiles all of it.
+5. **Tick split + host syncs**: ``decode_host_tick_seconds`` carries
+   both components, and a fused block costs ONE host sync.
+6. **Chaos**: a scripted outage at the ``serving.decode_step`` seam
+   mid-block fails the batch, frees pages, resets BOTH arenas'
+   donated pools, and the next request is clean and bit-exact.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import transformer_lm
+from deeplearning4j_tpu.models.transformer import (draft_transformer_lm,
+                                                   generate, sample_token)
+from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+from deeplearning4j_tpu.serving.decode import (DecodeScheduler,
+                                               PagedDecodeEngine)
+from deeplearning4j_tpu.util.metrics import MetricsRegistry
+from deeplearning4j_tpu.util.resilience import ManualClock
+
+VOCAB = 11
+
+
+def _net(max_cache_t=32, seed=5, n_layers=2):
+    conf = transformer_lm(VOCAB, n_layers=n_layers, d_model=16, n_heads=2,
+                          d_ff=32, seed=seed, input_ids=True,
+                          max_cache_t=max_cache_t)
+    return ComputationGraph(conf).init()
+
+
+def _draft(seed=123, max_cache_t=32):
+    return ComputationGraph(draft_transformer_lm(
+        VOCAB, d_model=16, n_heads=2, d_ff=32, seed=seed,
+        max_cache_t=max_cache_t)).init()
+
+
+def _scheduler(net, *, max_batch=4, page_size=8, pages_per_seq=4,
+               prefill_chunk=4, registry=None, clock=None, **kw):
+    registry = registry or MetricsRegistry()
+    engine_kw = {k: kw.pop(k) for k in ("block_len", "draft_net",
+                                        "draft_k", "num_pages")
+                 if k in kw}
+    engine = PagedDecodeEngine(net, max_batch=max_batch,
+                               page_size=page_size,
+                               pages_per_seq=pages_per_seq,
+                               prefill_chunk=prefill_chunk,
+                               registry=registry, **engine_kw)
+    return DecodeScheduler(engine, clock=clock or ManualClock(),
+                           registry=registry, start_thread=False, **kw)
+
+
+def _run(sched, reqs, limit=500):
+    steps = 0
+    while not all(r.done for r in reqs) and steps < limit:
+        sched.step_once()
+        steps += 1
+    assert all(r.done for r in reqs), [r.finish_reason for r in reqs]
+    return steps
+
+
+@pytest.fixture(scope="module")
+def oracle_net():
+    return _net()
+
+
+@pytest.fixture(scope="module")
+def draft_net():
+    return _draft()
+
+
+@pytest.fixture(scope="module")
+def fused_sched(oracle_net):
+    return _scheduler(oracle_net, block_len=4)
+
+
+@pytest.fixture(scope="module")
+def spec_sched(oracle_net, draft_net):
+    return _scheduler(oracle_net, draft_net=draft_net, draft_k=3)
+
+
+class _FixedRng:
+    """Stub Generator feeding a chosen uniform into the host sampler —
+    what makes host-vs-device parity directly testable."""
+
+    def __init__(self, u):
+        self.u = float(u)
+
+    def random(self, n=None):
+        return self.u if n is None else np.full(n, self.u)
+
+
+class TestSamplerParity:
+    """One documented sampling semantics, host AND device (satellite:
+    ``sample_token`` grows top-k/top-p; seeded parity at fixed rng)."""
+
+    def _device(self, p, t, tk, tp, u):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.sampling import sample_tokens
+        return int(sample_tokens(
+            jnp.asarray(np.asarray(p)[None]),
+            jnp.asarray([t], jnp.float32), jnp.asarray([tk], jnp.int32),
+            jnp.asarray([tp], jnp.float32),
+            jnp.asarray([u], jnp.float32))[0])
+
+    def test_seeded_host_vs_device_sweep(self):
+        """60 random (dist, temperature, top_k, top_p, u) trials — the
+        whole sweep rides ONE device dispatch (the sampler is vectorized
+        over lanes with per-lane params; that's also how the fused loop
+        calls it)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.sampling import sample_tokens
+        rng = np.random.default_rng(7)
+        n, v = 60, 24
+        p = np.stack([rng.dirichlet(np.ones(v) * rng.uniform(0.2, 3.0))
+                      for _ in range(n)])
+        t = rng.uniform(0.2, 2.0, n)
+        tk = rng.integers(0, v + 1, n)
+        tp = np.where(rng.random(n) < 0.7, rng.uniform(0.1, 1.0, n), 1.0)
+        u = rng.random(n)
+        dev = np.asarray(sample_tokens(
+            jnp.asarray(p), jnp.asarray(t, jnp.float32),
+            jnp.asarray(tk, jnp.int32), jnp.asarray(tp, jnp.float32),
+            jnp.asarray(u, jnp.float32)))
+        for i in range(n):
+            host = sample_token(p[i], float(t[i]), _FixedRng(u[i]),
+                                top_k=int(tk[i]), top_p=float(tp[i]))
+            assert host == int(dev[i]), (i, t[i], tk[i], tp[i], u[i])
+
+    def test_greedy_matches_and_needs_no_rng(self):
+        p = np.array([0.1, 0.5, 0.4])
+        assert sample_token(p) == 1 == self._device(p, 0.0, 0, 1.0, 0.0)
+
+    def test_top_k_restricts_support(self):
+        p = np.array([0.4, 0.3, 0.2, 0.1])
+        # top_k=2 at T=1: support {0, 1}, renormalized to 4/7, 3/7
+        for u, want in ((0.1, 0), (0.55, 0), (0.6, 1), (0.95, 1)):
+            assert sample_token(p, 1.0, _FixedRng(u), top_k=2) == want
+            assert self._device(p, 1.0, 2, 1.0, u) == want
+
+    def test_top_p_keeps_minimal_prefix(self):
+        p = np.array([0.4, 0.3, 0.2, 0.1])
+        # top_p=0.5: token 1's preceding mass (0.4) < 0.5 → kept; token
+        # 2's (0.7) ≥ 0.5 → dropped. Support {0, 1}.
+        got = {sample_token(p, 1.0, _FixedRng(u), top_p=0.5)
+               for u in np.linspace(0.01, 0.99, 17)}
+        assert got == {0, 1}
+        assert {self._device(p, 1.0, 0, 0.5, u)
+                for u in np.linspace(0.01, 0.99, 17)} == {0, 1}
+
+    def test_saturated_uniform_falls_back_to_last_support_token(self):
+        """u that rounds to 1.0f cannot emit a filtered-out token: the
+        draw falls back to the LAST positive-weight id (argmax over an
+        all-False mask would have returned id 0 — outside top-k here)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.sampling import (filtered_probs,
+                                                     inverse_cdf)
+        p = np.array([[0.05, 0.5, 0.3, 0.15]])
+        w = filtered_probs(jnp.asarray(p), jnp.asarray([1.0], jnp.float32),
+                           jnp.asarray([2], jnp.int32),
+                           jnp.asarray([1.0], jnp.float32))
+        tok = int(inverse_cdf(w, jnp.asarray([1.0], jnp.float32))[0])
+        assert tok == 2          # last id in the top-2 support, not 0
+        host = sample_token(p[0], 1.0, _FixedRng(1.0), top_k=2)
+        assert host == 2
+
+    def test_ties_break_toward_lower_id(self):
+        p = np.array([0.25, 0.25, 0.25, 0.25])
+        assert sample_token(p, 1.0, _FixedRng(0.1), top_k=1) == 0
+        assert self._device(p, 1.0, 1, 1.0, 0.1) == 0
+
+    def test_generate_accepts_filters(self, oracle_net):
+        out = generate(oracle_net, [1, 2, 3], 5, temperature=0.9,
+                       rng=np.random.default_rng(3), top_k=4, top_p=0.9)
+        assert len(out) == 5
+        assert all(0 <= t < VOCAB for t in out)
+
+
+class TestFusedParity:
+    """Greedy decode through the N-step fused block == ticked == oracle
+    (acceptance criterion: bit-exact for within-window sequences)."""
+
+    def test_ragged_batch_bitexact_vs_oracle(self, oracle_net, fused_sched):
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, VOCAB, n).astype(np.int32)
+                   for n in (3, 5, 7, 2)]
+        n_new = [4, 6, 2, 9]        # straddles the block_len=4 boundary
+        oracle = [generate(oracle_net, p, n).tolist()
+                  for p, n in zip(prompts, n_new)]
+        reqs = [fused_sched.submit(p, n) for p, n in zip(prompts, n_new)]
+        _run(fused_sched, reqs)
+        for o, r in zip(oracle, reqs):
+            assert r.tokens == o          # EXACT, not allclose
+        assert all(r.finish_reason == "max_tokens" for r in reqs)
+
+    def test_fused_matches_ticked_scheduler(self, oracle_net, fused_sched):
+        """The same prompts through the PR-6 ticked path (block_len=1)
+        and the fused path produce identical greedy tokens."""
+        ticked = _scheduler(oracle_net)           # block_len=1 default
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, VOCAB, n).astype(np.int32)
+                   for n in (4, 6)]
+        a = [fused_sched.submit(p, 7) for p in prompts]
+        _run(fused_sched, a)
+        b = [ticked.submit(p, 7) for p in prompts]
+        _run(ticked, b)
+        for x, y in zip(a, b):
+            assert x.tokens == y.tokens
+
+    def test_mid_block_eos_self_retires(self, oracle_net, fused_sched):
+        """EOS landing mid-block retires the lane ON DEVICE: the valid
+        prefix stops at the EOS token and later in-block steps cannot
+        corrupt state (the next request reuses the pages cleanly)."""
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, VOCAB, 4)
+        free_run = generate(oracle_net, prompt, 8)
+        eos = int(free_run[1])        # hits at block step 2 of 4
+        oracle = generate(oracle_net, prompt, 8, eos_id=eos)
+        req = fused_sched.submit(prompt, 8, eos_id=eos)
+        _run(fused_sched, [req])
+        assert req.tokens == oracle.tolist()
+        assert req.finish_reason == "eos"
+        assert len(req.tokens) < 8
+        assert fused_sched.engine.arena.allocator.pages_in_use == 0
+
+    def test_block_straddles_page_boundary(self, oracle_net):
+        """A block whose writes cross a physical page boundary (and
+        draw a fresh page mid-block) stays bit-exact."""
+        sched = _scheduler(oracle_net, page_size=4, pages_per_seq=8,
+                           block_len=8, prefill_chunk=4)
+        prompt = np.asarray([1, 2, 3], np.int32)   # block writes 3..10
+        req = sched.submit(prompt, 10)
+        _run(sched, [req])
+        assert req.tokens == generate(oracle_net, prompt, 10).tolist()
+
+    def test_budget_smaller_than_block(self, oracle_net, fused_sched):
+        """max_new_tokens below block_len self-retires at the budget —
+        exactly max_new tokens, never block_len."""
+        req = fused_sched.submit([1, 2], 2)
+        _run(fused_sched, [req])
+        assert len(req.tokens) == 2
+        assert req.tokens == generate(oracle_net, [1, 2], 2).tolist()
+
+    def test_sampled_block_reproducible(self, fused_sched):
+        outs = []
+        for _ in range(2):
+            req = fused_sched.submit([1, 2, 3], 6, temperature=0.8,
+                                     seed=42, top_k=6, top_p=0.9)
+            _run(fused_sched, [req])
+            outs.append(req.tokens)
+        assert outs[0] == outs[1]
+        assert all(0 <= t < VOCAB for t in outs[0])
+
+    def test_one_sync_per_block(self, oracle_net):
+        """The acceptance gauge behind the whole PR: a fused block of N
+        tokens costs ONE host round-trip (the ticked path paid N)."""
+        reg = MetricsRegistry()
+        sched = _scheduler(oracle_net, registry=reg, block_len=8,
+                           prefill_chunk=4)
+        req = sched.submit([1, 2, 3, 4], 8)
+        _run(sched, [req])
+        fused = reg.get("decode_dispatches_total").value(kind="fused")
+        toks = reg.get("decode_tokens_total").value(phase="decode")
+        # token 1 of 8 came off the prefill dispatch; the remaining 7
+        # decode-phase tokens cost exactly ONE fused dispatch
+        assert toks == 7
+        assert fused == 1
+
+    def test_bad_sampling_params_rejected(self, fused_sched):
+        with pytest.raises(ValueError, match="top_p"):
+            fused_sched.submit([1], 2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            fused_sched.submit([1], 2, top_k=-1)
+
+    def test_huge_top_k_normalized_to_unfiltered(self, fused_sched):
+        """top_k >= vocab filters nothing — normalized to 0 at submit so
+        an unbounded client value can't OverflowError the int32 block
+        arrays mid-tick (which would error-retire the whole batch)."""
+        req = fused_sched.submit([1, 2], 3, temperature=0.8, seed=1,
+                                 top_k=2**31)
+        assert req.top_k == 0
+        _run(fused_sched, [req])
+        assert len(req.tokens) == 3
+
+    def test_block_len_bucketed_pow2_and_capped(self, oracle_net):
+        eng = PagedDecodeEngine(oracle_net, max_batch=1, page_size=8,
+                                pages_per_seq=4, block_len=5,
+                                registry=MetricsRegistry())
+        assert eng.block_len == 8
+        with pytest.raises(ValueError, match="window"):
+            PagedDecodeEngine(oracle_net, max_batch=1, page_size=8,
+                              pages_per_seq=4, block_len=64,
+                              registry=MetricsRegistry())
+
+
+class TestSpeculative:
+    """Draft K, verify in one batched pass, accept/reject + bonus on
+    device — greedy output identical to target-only decode."""
+
+    def test_spec_greedy_equals_target_only(self, oracle_net, spec_sched):
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, VOCAB, n).astype(np.int32)
+                   for n in (3, 6, 2)]
+        n_new = [5, 8, 3]
+        oracle = [generate(oracle_net, p, n).tolist()
+                  for p, n in zip(prompts, n_new)]
+        reqs = [spec_sched.submit(p, n) for p, n in zip(prompts, n_new)]
+        _run(spec_sched, reqs)
+        for o, r in zip(oracle, reqs):
+            assert r.tokens == o
+        assert spec_sched.engine.arena.allocator.pages_in_use == 0
+
+    def test_perfect_draft_accepts_everything(self, oracle_net):
+        """Target-as-draft is the acceptance-rate upper bound: greedy
+        drafts always equal greedy verification, so every block accepts
+        all K and emits K+1 tokens."""
+        reg = MetricsRegistry()
+        sched = _scheduler(oracle_net, registry=reg, draft_net=oracle_net,
+                           draft_k=3)
+        req = sched.submit([1, 2, 3], 8)
+        _run(sched, [req])
+        assert req.tokens == generate(oracle_net, [1, 2, 3], 8).tolist()
+        acc = reg.get("decode_draft_tokens_total").value(result="accepted")
+        rej = reg.get("decode_draft_tokens_total").value(result="rejected")
+        assert acc > 0 and rej == 0
+
+    def test_acceptance_rate_sanity(self, oracle_net, spec_sched):
+        """An unrelated draft accepts SOME fraction in [0, 1); every
+        CHANCED draft (valid context within the write budget) is
+        accounted accepted-or-rejected — never more than K per block,
+        and beyond-budget garbage drafts count as neither; output is
+        still exactly the target's. Counter DELTAS, so the module
+        scheduler (and its compiled traces) are reused."""
+        reg = spec_sched.registry
+        acc0 = reg.get("decode_draft_tokens_total").value(result="accepted")
+        rej0 = reg.get("decode_draft_tokens_total").value(result="rejected")
+        blk0 = reg.get("decode_dispatches_total").value(kind="verify")
+        req = spec_sched.submit([4, 5, 6], 9)
+        _run(spec_sched, [req])
+        assert req.tokens == generate(oracle_net, [4, 5, 6], 9).tolist()
+        acc = reg.get("decode_draft_tokens_total").value(
+            result="accepted") - acc0
+        rej = reg.get("decode_draft_tokens_total").value(
+            result="rejected") - rej0
+        blocks = reg.get("decode_dispatches_total").value(
+            kind="verify") - blk0
+        drafted = acc + rej
+        assert 0 < drafted <= blocks * 3
+        assert 0.0 <= acc / drafted < 1.0
+
+    def test_spec_eos_stops_inside_accepted_prefix(self, oracle_net,
+                                                   spec_sched):
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(0, VOCAB, 4)
+        free_run = generate(oracle_net, prompt, 8)
+        eos = int(free_run[2])
+        oracle = generate(oracle_net, prompt, 8, eos_id=eos)
+        req = spec_sched.submit(prompt, 8, eos_id=eos)
+        _run(spec_sched, [req])
+        assert req.tokens == oracle.tolist()
+        assert req.finish_reason == "eos"
+
+    def test_spec_sampled_reproducible(self, spec_sched):
+        outs = []
+        for _ in range(2):
+            req = spec_sched.submit([2, 3], 6, temperature=0.7, seed=11,
+                                    top_k=8)
+            _run(spec_sched, [req])
+            outs.append(req.tokens)
+        assert outs[0] == outs[1]
+        assert all(0 <= t < VOCAB for t in outs[0])
+
+    def test_spec_bitexact_up_to_window_edge(self):
+        """A sequence whose prompt+max_new fills the window EXACTLY
+        stays bit-exact: the per-lane write budget masks the verify/
+        draft slots past the last possible token, so the final blocks
+        near the edge cannot trigger premature page eviction (the bug
+        this test pins: unmasked K-overshoot writes rotated live pages
+        out and diverged from the oracle)."""
+        net = _net(max_cache_t=16, n_layers=1)
+        draft = _draft(max_cache_t=16)
+        sched = _scheduler(net, max_batch=2, page_size=8, pages_per_seq=2,
+                           prefill_chunk=8, draft_net=draft, draft_k=3)
+        prompt = np.arange(4) % VOCAB
+        req = sched.submit(prompt, 12)          # 4 + 12 = 16 = window
+        _run(sched, [req])
+        assert req.tokens == generate(net, prompt, 12).tolist()
+        reg = sched.registry
+        assert reg.get("kv_pages_evicted_total").value() == 0
+
+    def test_spec_long_generation_past_window(self):
+        """Past the window the spec path slides by page eviction like
+        every other mode — completion and page hygiene, no oracle
+        comparison (the documented granularity divergence)."""
+        net = _net(max_cache_t=16, n_layers=1)
+        draft = _draft(max_cache_t=16)
+        sched = _scheduler(net, max_batch=2, page_size=8, pages_per_seq=2,
+                           prefill_chunk=8, draft_net=draft, draft_k=3)
+        req = sched.submit(np.arange(5) % VOCAB, 30)
+        _run(sched, [req])
+        assert len(req.tokens) == 30
+        assert sched.engine.arena.allocator.pages_in_use == 0
+
+    def test_draft_vocab_mismatch_rejected(self, oracle_net):
+        other = ComputationGraph(transformer_lm(
+            VOCAB + 2, n_layers=1, d_model=16, n_heads=2, d_ff=32,
+            input_ids=True, max_cache_t=32)).init()
+        with pytest.raises(ValueError, match="vocab"):
+            PagedDecodeEngine(oracle_net, max_batch=2, page_size=8,
+                              pages_per_seq=4, draft_net=other,
+                              registry=MetricsRegistry())
+
+    def test_draft_arena_is_pools_only(self, spec_sched):
+        eng = spec_sched.engine
+        assert eng.draft_arena.allocator is None
+        assert len(eng.draft_arena.k_pools) == 2   # draft is 2-layer
+
+
+class TestTraceLadder:
+    """The block-length axis joins the per-bucket ladder as a FIXED
+    trace set: 1 compile per (lane-bucket, shape) under churn."""
+
+    def test_warmup_then_churn_pins_fused_ladder(self, oracle_net):
+        """warmup() precompiles the ENTIRE (bucket, block-length) trace
+        set, and admission/retirement churn afterwards compiles NOTHING
+        — one assertion covering both halves of the pin."""
+        reg = MetricsRegistry()
+        sched = _scheduler(oracle_net, registry=reg, block_len=4)
+        sched.engine.warmup()
+        before = {s["labels"]["fn"]: s["value"] for s in
+                  reg.get("jit_retraces_total").snapshot()["series"]}
+        allowed = ({f"paged_decode[S{b}xT4xP4]" for b in (1, 2, 4)}
+                   | {f"fused_decode[S{b}xN4xP4]" for b in (1, 2, 4)})
+        assert set(before) == allowed, before
+        assert all(v == 1 for v in before.values()), before
+        rng = np.random.default_rng(9)
+        reqs = []
+        for wave in range(3):                   # churn: 3 waves of 3
+            reqs += [sched.submit(rng.integers(0, VOCAB, 1 + wave + i),
+                                  3 + i) for i in range(3)]
+            for _ in range(3):
+                sched.step_once()
+        _run(sched, reqs)
+        after = {s["labels"]["fn"]: s["value"] for s in
+                 reg.get("jit_retraces_total").snapshot()["series"]}
+        assert before == after        # zero compiles after warmup
+
+    def test_retrace_pin_speculative_under_churn(self):
+        reg = MetricsRegistry()
+        sched = _scheduler(_net(), registry=reg, draft_net=_draft(),
+                           draft_k=3, max_batch=2)
+        rng = np.random.default_rng(10)
+        reqs = []
+        for wave in range(2):
+            reqs += [sched.submit(rng.integers(0, VOCAB, 2 + wave + i),
+                                  4 + i) for i in range(2)]
+            for _ in range(3):
+                sched.step_once()
+        _run(sched, reqs)
+        series = reg.get("jit_retraces_total").snapshot()["series"]
+        assert all(s["value"] == 1 for s in series), series
+        names = {s["labels"]["fn"] for s in series}
+        allowed = set()
+        for b in (1, 2):
+            allowed |= {f"paged_decode[S{b}xT4xP4]",
+                        f"draft_prefill[S{b}xT4xP4]",
+                        f"spec_draft[S{b}xK3xP4]",
+                        f"spec_verify[S{b}xK3xP4]"}
+        assert names <= allowed, names
+
+
+class TestTickSplitMetrics:
+    """Satellite: the host-tick round-trip claim is a measured gauge —
+    ``decode_host_tick_seconds`` splits bookkeeping vs dispatch wall."""
+
+    def test_components_populated_and_exposed(self, oracle_net):
+        reg = MetricsRegistry()
+        sched = _scheduler(oracle_net, registry=reg, block_len=4)
+        req = sched.submit([1, 2, 3], 6)
+        _run(sched, [req])
+        hist = reg.get("decode_host_tick_seconds")
+        counts = {s["labels"]["component"]: s["count"]
+                  for s in hist.snapshot()["series"]}
+        assert counts.get("dispatch", 0) > 0
+        assert counts.get("bookkeeping", 0) > 0
+        assert reg.get("decode_host_syncs_total").value() > 0
+        kinds = {s["labels"]["kind"]: s["value"] for s in
+                 reg.get("decode_dispatches_total").snapshot()["series"]}
+        assert kinds.get("fused", 0) > 0 and kinds.get("paged", 0) > 0
+        text = reg.expose()
+        assert "decode_host_tick_seconds" in text
+        assert "decode_host_syncs_total" in text
+
+
+class TestChaos:
+    @pytest.mark.chaos
+    def test_fault_mid_fused_block(self, oracle_net):
+        """An outage at the serving.decode_step seam on a FUSED block
+        fails the in-flight batch, frees its pages, and the scheduler
+        keeps serving bit-exact on the rebuilt (donated) pools."""
+        from deeplearning4j_tpu.util import faults
+        sched = _scheduler(oracle_net, block_len=4)
+        victim = sched.submit([1, 2, 3], 6)
+        plan = faults.FaultPlan().fail_at(
+            "serving.decode_step", call=2,
+            exc=RuntimeError("chip fell over"))
+        with plan.active():
+            _run(sched, [victim])
+            assert victim.finish_reason == "error"
+            assert sched.engine.arena.allocator.pages_in_use == 0
+            retry = sched.submit([1, 2, 3], 6)
+            _run(sched, [retry])
+        assert retry.tokens == generate(oracle_net, [1, 2, 3], 6).tolist()
+        # call 2 is the decode_block dispatch (call 1 was the prefill)
+        assert plan.triggered == [("serving.decode_step", 2)]
+
+    @pytest.mark.chaos
+    def test_fault_mid_spec_block_resets_both_arenas(self, oracle_net,
+                                                     draft_net):
+        from deeplearning4j_tpu.util import faults
+        sched = _scheduler(oracle_net, draft_net=draft_net, draft_k=3)
+        eng = sched.engine
+        t_shapes = [tuple(p.shape) for p in eng.arena.k_pools]
+        d_shapes = [tuple(p.shape) for p in eng.draft_arena.k_pools]
+        victim = sched.submit([1, 2, 3], 6)
+        plan = faults.FaultPlan().fail_at(
+            "serving.decode_step", call=2,
+            exc=RuntimeError("chip fell over"))
+        with plan.active():
+            _run(sched, [victim])
+            assert victim.finish_reason == "error"
+            retry = sched.submit([1, 2, 3], 6)
+            _run(sched, [retry])
+        assert retry.tokens == generate(oracle_net, [1, 2, 3], 6).tolist()
+        assert [tuple(p.shape) for p in eng.arena.k_pools] == t_shapes
+        assert [tuple(p.shape) for p in eng.draft_arena.k_pools] == d_shapes
