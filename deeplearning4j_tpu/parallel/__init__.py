@@ -21,22 +21,29 @@ TPU-native design — two modes, both expressed as XLA SPMD programs over a
   ``ParameterAveragingTrainingMaster.java:763-832``.
 """
 
-from .distributed import (global_mesh, host_local_batch, initialize,
+from .distributed import (global_mesh, host_local_batch,
+                          host_replicated_batch, initialize,
                           is_initialized, process_count, process_index)
+from .elastic import (CoordinationStore, ElasticConfig, ElasticTrainer,
+                      FileCoordinationStore, InMemoryCoordinationStore)
 from .expert import ExpertParallelGraphTrainer, ExpertParallelTrainer
 from .mesh import create_mesh, data_parallel_mesh, mesh_devices
 from .pipeline import GraphPipelineTrainer, PipelineParallelTrainer
 from .sequence import SequenceParallelGraphTrainer
 from .tensor import TensorParallelGraphTrainer, TensorParallelTrainer
-from .training_master import (ParameterAveragingTrainingMaster,
+from .training_master import (ElasticTrainingMaster,
+                              ParameterAveragingTrainingMaster,
                               SyncTrainingMaster, Trainer, TrainingMaster)
 from .wrapper import ParallelWrapper
 
 __all__ = ["ParallelWrapper", "create_mesh", "data_parallel_mesh",
            "mesh_devices", "initialize", "is_initialized", "global_mesh",
-           "host_local_batch", "process_count", "process_index",
-           "TrainingMaster", "Trainer", "SyncTrainingMaster",
-           "ParameterAveragingTrainingMaster", "TensorParallelTrainer",
+           "host_local_batch", "host_replicated_batch", "process_count",
+           "process_index", "TrainingMaster", "Trainer",
+           "SyncTrainingMaster", "ParameterAveragingTrainingMaster",
+           "ElasticTrainingMaster", "ElasticTrainer", "ElasticConfig",
+           "CoordinationStore", "FileCoordinationStore",
+           "InMemoryCoordinationStore", "TensorParallelTrainer",
            "PipelineParallelTrainer", "GraphPipelineTrainer",
            "SequenceParallelGraphTrainer", "ExpertParallelTrainer",
            "ExpertParallelGraphTrainer", "TensorParallelGraphTrainer"]

@@ -13,17 +13,30 @@ device list, and XLA routes collectives over ICI within a slice and DCN
 across slices. There is no driver and no parameter shipping — the "cluster
 orchestration layer" collapses into (1) this bootstrap, (2) a global mesh
 whose outer axis maps to the process/DCN boundary, and (3) per-process data
-feeding (`host_local_batch`).
+feeding (`host_local_batch` for batch-sharded axes, `host_replicated_batch`
+for tensor/pipeline-axis meshes).
+
+Membership + round state for ELASTIC fleets (hosts that may die and
+rejoin) deliberately does NOT ride on ``jax.distributed``: its
+collectives hang on a dead peer, the exact failure this layer must
+survive. That state lives on the coordination-store seam instead —
+heartbeat leases, the append-only membership log and the round ledger in
+:mod:`deeplearning4j_tpu.parallel.elastic` — and ``agree_on_digest``
+takes an injectable ``allgather`` precisely so the elastic layer can run
+the same commit gate over its store-backed gather.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Dict, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 _initialized = False
 
@@ -58,8 +71,33 @@ def initialize(coordinator_address: Optional[str] = None,
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = list(local_device_ids)
+    _enable_cpu_collectives()
     jax.distributed.initialize(**kwargs)
     _initialized = True
+
+
+def _enable_cpu_collectives() -> None:
+    """A multi-process CPU runtime needs a cross-process collectives
+    implementation — the default ("none") raises INVALID_ARGUMENT
+    ("Multiprocess computations aren't implemented on the CPU backend")
+    at the FIRST collective, which presents as a mysteriously failing
+    worker. Select gloo before the backend initializes; harmless for
+    TPU/GPU runtimes (the knob only affects CPU client creation)."""
+    try:
+        from jax._src import xla_bridge as _xb
+        cur = _xb.CPU_COLLECTIVES_IMPLEMENTATION.value
+    except Exception:
+        return                      # older/newer jax: nothing to do
+    if cur != "none":
+        return
+    try:
+        from jax._src.lib import xla_client
+        if not hasattr(xla_client._xla, "make_gloo_tcp_collectives"):
+            return                  # jaxlib built without gloo
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:               # never block a TPU pod bootstrap
+        logger.warning("could not enable gloo CPU collectives",
+                       exc_info=True)
 
 
 def is_initialized() -> bool:
@@ -135,6 +173,30 @@ def agree_on_digest(digest: str, *, allgather=None) -> bool:
         allgather = multihost_utils.process_allgather
     world = np.atleast_2d(np.asarray(allgather(local)))
     return bool((world == world[0]).all())
+
+
+def host_replicated_batch(mesh: Mesh, *arrays):
+    """Assemble REPLICATED global device arrays from identical per-process
+    host arrays — the feeding path for meshes whose axes carry model
+    state rather than batch shards (tensor/pipeline-axis meshes crossing
+    the process boundary, VERDICT item 7). Every process must pass the
+    same full array; the result is replicated over the whole mesh so a
+    tensor-parallel step can consume it regardless of which axis spans
+    DCN. Single-process: plain ``device_put`` with a replicated sharding.
+    """
+    sharding = NamedSharding(mesh, P())
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        a = np.asarray(a)
+        if jax.process_count() == 1:
+            out.append(jax.device_put(a, sharding))
+        else:
+            out.append(jax.make_array_from_process_local_data(
+                sharding, a, a.shape))
+    return out[0] if len(out) == 1 else tuple(out)
 
 
 def host_local_batch(mesh: Mesh, *arrays, axis: str = "data"):

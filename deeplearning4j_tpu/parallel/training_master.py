@@ -16,6 +16,14 @@ SPMD programs over a ``jax.sharding.Mesh`` (single- or multi-host via
 - :class:`ParameterAveragingTrainingMaster` — independent replica steps with
   params/updater averaged every ``averaging_frequency`` iterations (exact
   reference semantics, right choice when the reconcile must cross DCN).
+- :class:`ElasticTrainingMaster` — bounded-staleness local-SGD sync rounds
+  over a shared coordination store, one PROCESS per host, with heartbeat/
+  lease membership: a preempted host is evicted after a deadline instead of
+  stalling the fleet, and a restarted host rejoins from its durable
+  snapshot (:mod:`deeplearning4j_tpu.parallel.elastic`). Unlike the two
+  SPMD strategies it does not run collectives — a dead peer must not hang
+  the survivors — so its trainer's ``fit(batch_fn, rounds=R)`` drives
+  seeded per-host batches rather than a shared iterator.
 
 Usage::
 
@@ -127,3 +135,61 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         return Trainer(ParallelWrapper(
             net, mesh=mesh, averaging_frequency=self.averaging_frequency,
             stats=self._stats()))
+
+
+class ElasticTrainingMaster(TrainingMaster):
+    """Elastic bounded-staleness local SGD across host PROCESSES.
+
+    Every host constructs the same master (same ``fleet`` spec and
+    coordination directory, its own ``host`` id) and calls
+    ``build(net[, mesh])``; with a mesh the local steps run data-parallel
+    over this host's devices through a sync-mode :class:`ParallelWrapper`.
+    The returned :class:`~deeplearning4j_tpu.parallel.elastic
+    .ElasticTrainer` exposes ``fit(batch_fn, rounds=R)`` plus the
+    evict/rejoin machinery; see :mod:`deeplearning4j_tpu.parallel.elastic`
+    for the protocol and its determinism guarantees.
+    """
+
+    def __init__(self, coordination_dir, fleet, host, *,
+                 steps_per_round: int = 4, max_staleness: int = 1,
+                 lease_s: float = 10.0,
+                 evict_after_s: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every_rounds: int = 1,
+                 watchdog_s: Optional[float] = None,
+                 handle_signals: bool = False, registry=None,
+                 collect_stats: bool = False,
+                 blocking_stats: bool = False):
+        super().__init__(collect_stats, blocking_stats)
+        self.coordination_dir = coordination_dir
+        self.fleet = tuple(fleet)
+        self.host = host
+        self.steps_per_round = steps_per_round
+        self.max_staleness = max_staleness
+        self.lease_s = lease_s
+        self.evict_after_s = evict_after_s
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every_rounds = checkpoint_every_rounds
+        self.watchdog_s = watchdog_s
+        self.handle_signals = handle_signals
+        self.registry = registry
+
+    def build(self, net, mesh: Optional[Mesh] = None):
+        from .elastic import ElasticConfig, ElasticTrainer
+        cfg = ElasticConfig(
+            fleet=self.fleet, host=self.host,
+            steps_per_round=self.steps_per_round,
+            max_staleness=self.max_staleness, lease_s=self.lease_s,
+            evict_after_s=self.evict_after_s,
+            checkpoint_every_rounds=self.checkpoint_every_rounds)
+        factory = None
+        if mesh is not None:
+            stats = self._stats()
+            factory = (lambda n: ParallelWrapper(
+                n, mesh=mesh, averaging_frequency=1, stats=stats))
+        return ElasticTrainer(
+            net, self.coordination_dir, cfg,
+            checkpoint_dir=self.checkpoint_dir, registry=self.registry,
+            watchdog_s=self.watchdog_s,
+            handle_signals=self.handle_signals,
+            stepper_factory=factory)

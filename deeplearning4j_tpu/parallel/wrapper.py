@@ -5,7 +5,15 @@ parameter averaging every ``averagingFrequency`` iterations, updater-state
 averaging at ``:163-186``) and ``ParameterAveragingTrainingMaster.java:763-832``
 (the Spark multi-node variant of the same algorithm).
 
-See package docstring for the two modes (sync SPMD vs local-SGD).
+See package docstring for the two modes (sync SPMD vs local-SGD). Both
+modes are SINGLE-PROCESS programs over one mesh: every replica lives in
+this process, so a replica cannot "die" independently. The cross-PROCESS
+analog of the local-SGD mode — where a host can be preempted mid-window
+and rejoin — is :mod:`deeplearning4j_tpu.parallel.elastic`, which also
+composes with this class: an ``ElasticTrainer`` built with a mesh runs
+its per-host local steps through a sync-mode ``ParallelWrapper``
+(``stepper_factory``), nesting in-host data parallelism under the
+fleet-level bounded-staleness rounds.
 """
 
 from __future__ import annotations

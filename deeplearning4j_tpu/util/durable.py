@@ -45,7 +45,16 @@ Chaos story: the fit loop exposes a ``"training.step"`` seam
 (:mod:`deeplearning4j_tpu.util.faults`) hit once per dispatched step, so
 tests script kills at EXACT step boundaries (raise, ``os._exit``, or
 self-SIGTERM) — see ``tests/test_durable.py`` and the fork-and-kill
-subprocess harness ``tests/_kill_harness.py``.
+subprocess harness ``tests/_kill_harness.py`` (which also runs N-process
+ELASTIC fleets with per-rank kill plans).
+
+Elastic rejoin rides this module: each elastic host keeps its own
+:class:`CheckpointStore` of round-boundary snapshots whose cursor
+carries the ROUND index, so a preempted host restores the newest
+snapshot and deterministically replays its missed rounds
+(:mod:`deeplearning4j_tpu.parallel.elastic`); the :class:`StepWatchdog`
+context provider carries the elastic round/waiting-on state into the
+expiry dump.
 """
 
 from __future__ import annotations
@@ -737,6 +746,11 @@ class StepWatchdog:
                 "queue_depths": queues,
                 "breakers": _resilience.breaker_states(),
                 "active_span": ctx.get("span"),
+                # elastic fleets stamp {host, round, phase, waiting_on}
+                # via their context provider, so a watchdog expiry names
+                # the peer that stalled the sync round without reading
+                # the flight-recorder dump
+                "elastic": ctx.get("elastic"),
                 "context": ctx}
 
     def _expire(self) -> None:

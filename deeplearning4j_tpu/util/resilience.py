@@ -123,6 +123,30 @@ class Deadline:
             raise DeadlineExceeded(f"{what} exceeded its deadline")
 
 
+def wait_until(predicate: Callable[[], bool], *,
+               timeout_s: Optional[float] = None, poll_s: float = 0.02,
+               clock: Clock = SYSTEM_CLOCK,
+               desc: str = "condition",
+               on_poll: Optional[Callable[[], None]] = None) -> bool:
+    """Deadline-bounded polling wait: True as soon as ``predicate()`` is
+    truthy, False once ``timeout_s`` elapses (None = wait forever). The
+    replacement for fixed test sleeps — a passing wait returns at the
+    first poll instead of sleeping the worst case, and a hung condition
+    fails at the deadline instead of hanging the suite. ``on_poll`` runs
+    every iteration (pet a watchdog, publish a heartbeat)."""
+    deadline = Deadline(timeout_s, clock)
+    while True:
+        if predicate():
+            return True
+        if deadline.expired:
+            logger.warning("wait_until(%s) expired after %.1fs", desc,
+                           float(timeout_s or 0))
+            return False
+        if on_poll is not None:
+            on_poll()
+        clock.sleep(poll_s)
+
+
 class RetryPolicy:
     """Exponential-backoff retry with bounded attempts and a total
     deadline.

@@ -22,6 +22,18 @@ notice, dump the flight recorder, and interrupt the hung dispatch).
 The flight recorder dumps into checkpoint_dir (DL4JTPU_FLIGHT_DIR is set
 before training starts), so the parent can read the black box of a child
 that died hung.
+
+FLEET MODE (``mode: "elastic"``): N children form an elastic
+bounded-staleness local-SGD fleet over a shared FileCoordinationStore
+(``store_dir``), each with its OWN kill plan (``kill_mode`` /
+``kill_at_iteration`` per rank — stagger them to script multi-failure
+scenarios). ``run_fleet`` spawns the ranks concurrently, optionally
+RESTARTS a rank after its first process exits (the preemption-then-
+reschedule scenario: the restart restores the newest durable snapshot
+and rejoins), and SIGKILLs hang-mode ranks once every other rank
+finished — the parent is the cluster scheduler of the chaos story.
+Each child writes ``result_<host>.json`` (final digest, agreed flag,
+rounds, membership-transition counts) into its checkpoint dir.
 """
 
 from __future__ import annotations
@@ -79,12 +91,22 @@ def params_sha(net) -> str:
     return h.hexdigest()
 
 
-def run_child(config: dict, timeout: float = 120.0):
-    """Spawn the harness as a subprocess; returns (returncode, stderr)."""
+def _child_env():
     repo_root = os.path.dirname(os.path.dirname(HARNESS))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the virtual 8-device mesh of the test process is pointless here
+    # and slows child startup; elastic hosts are single-device
+    env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = repo_root + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return repo_root, env
+
+
+def run_child(config: dict, timeout: float = 120.0):
+    """Spawn the harness as a subprocess; returns (returncode, stderr)."""
+    repo_root, env = _child_env()
+    if "mode" not in config:
+        env["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
     proc = subprocess.run(
         [sys.executable, HARNESS, json.dumps(config)],
         capture_output=True, text=True, timeout=timeout, env=env,
@@ -92,19 +114,257 @@ def run_child(config: dict, timeout: float = 120.0):
     return proc.returncode, proc.stderr
 
 
+# ----------------------------------------------------------------------
+# fleet mode: N elastic hosts with per-rank kill plans
+# ----------------------------------------------------------------------
+
+def elastic_fleet_configs(n: int, store_dir: str, base_dir: str, *,
+                          rounds: int = 4, steps_per_round: int = 2,
+                          max_staleness: int = 1, lease_s: float = 1.0,
+                          evict_after_s: float = None, seed: int = 7,
+                          kill_plans: dict = None,
+                          watchdog_s: float = None) -> list:
+    """One config dict per rank. ``kill_plans`` maps rank ->
+    {"kill_mode": ..., "kill_at_iteration": ...} (iteration counts LOCAL
+    steps on that rank; the "training.step" seam fires before each)."""
+    fleet = [f"h{i}" for i in range(n)]
+    out = []
+    for i, host in enumerate(fleet):
+        cfg = {
+            "mode": "elastic", "fleet": fleet, "host": host,
+            "store_dir": store_dir,
+            "checkpoint_dir": os.path.join(base_dir, host),
+            "rounds": rounds, "steps_per_round": steps_per_round,
+            "max_staleness": max_staleness, "lease_s": lease_s,
+            "evict_after_s": evict_after_s, "seed": seed,
+            "watchdog_s": watchdog_s,
+        }
+        cfg.update((kill_plans or {}).get(i, {}))
+        out.append(cfg)
+    return out
+
+
+def spawn_fleet_child(config: dict) -> "subprocess.Popen":
+    repo_root, env = _child_env()
+    return subprocess.Popen(
+        [sys.executable, HARNESS, json.dumps(config)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=repo_root)
+
+
+def fleet_result(config: dict):
+    """The result_<host>.json a fleet child wrote, or None."""
+    path = os.path.join(config["checkpoint_dir"],
+                        f"result_{config['host']}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def run_fleet(configs: list, *, timeout: float = 300.0,
+              restarts: dict = None, restart_delay_s: float = 0.0,
+              poll_s: float = 0.2) -> dict:
+    """Run an elastic fleet to completion under a hard deadline.
+
+    ``restarts`` maps host id -> replacement config: when that host's
+    first process EXITS (clean preemption drain or hard kill alike), the
+    replacement spawns ``restart_delay_s`` later — hold it past the
+    lease so the survivors OBSERVE the dropout (evict -> rejoin
+    transitions) instead of racing the reschedule. Hang-mode
+    ranks never exit on their own; once every other rank is done they
+    are SIGKILLed (the cluster reclaiming a wedged machine). Returns
+    {host: {"rc": int, "stderr": str, "result": dict|None,
+    "restarted": bool}}; raises TimeoutError past ``timeout`` (all
+    children are killed first — a protocol deadlock must fail fast, not
+    eat the suite's budget)."""
+    import time as _time
+    restarts = dict(restarts or {})
+    by_host = {c["host"]: c for c in configs}
+    procs = {c["host"]: spawn_fleet_child(c) for c in configs}
+    hang_hosts = {c["host"] for c in configs
+                  if c.get("kill_mode") == "hang"}
+    out = {h: {"rc": None, "stderr": "", "restarted": False}
+           for h in procs}
+    deadline = _time.monotonic() + timeout
+    due: dict = {}          # host -> (config, spawn_at)
+    try:
+        while True:
+            for h, p in list(procs.items()):
+                rc = p.poll()
+                if rc is None or out[h]["rc"] is not None:
+                    continue
+                _, err = p.communicate()
+                out[h]["rc"] = rc
+                out[h]["stderr"] += err or ""
+                if h in restarts:
+                    due[h] = (restarts.pop(h),
+                              _time.monotonic() + restart_delay_s)
+            for h, (cfg, at) in list(due.items()):
+                if _time.monotonic() >= at:
+                    del due[h]
+                    procs[h] = spawn_fleet_child(cfg)
+                    by_host[h] = cfg
+                    out[h] = {"rc": None, "stderr": out[h]["stderr"],
+                              "restarted": True}
+            pending = [h for h, p in procs.items() if p.poll() is None]
+            if not pending and not due:
+                break
+            if set(pending) <= hang_hosts and not restarts and not due:
+                # only wedged ranks left: reclaim them
+                for h in pending:
+                    procs[h].kill()
+                    _, err = procs[h].communicate()
+                    out[h]["rc"] = "killed_hung"
+                    out[h]["stderr"] += err or ""
+                break
+            if _time.monotonic() > deadline:
+                for h in pending:
+                    procs[h].kill()
+                    procs[h].communicate()
+                raise TimeoutError(
+                    f"fleet did not finish within {timeout}s; still "
+                    f"running: {pending}")
+            _time.sleep(poll_s)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for h in out:
+        out[h]["result"] = fleet_result(by_host[h])
+    return out
+
+
+def elastic_batch_fn(seed: int, host_index: int):
+    """Per-host data schedule as a PURE function of (round, step) —
+    process-restart-stable (no python hash salting, no iterator state),
+    which is what makes rejoin replay bit-exact."""
+    import numpy as np
+
+    def fn(round_, step):
+        s = (int(seed) * 1000003 + host_index * 10007
+             + int(round_) * 101 + int(step)) % (2 ** 31)
+        rng = np.random.default_rng(s)
+        x = rng.normal(size=(BATCH, FEATURES)).astype(np.float32)
+        y = np.eye(CLASSES, dtype=np.float32)[
+            rng.integers(0, CLASSES, BATCH)]
+        return x, y
+    return fn
+
+
+def _install_kill_plan(plan, config) -> None:
+    """Per-rank kill plan on the shared "training.step" seam: the seam
+    fires BEFORE dispatching the (iteration+1)-th local step."""
+    import signal
+
+    kill_mode = config.get("kill_mode")
+    kill_at = config.get("kill_at_iteration")
+    if not kill_mode:
+        return
+
+    def kill(payload):
+        if payload["iteration"] == kill_at:
+            if kill_mode == "exit":
+                os._exit(9)
+            if kill_mode == "hang":
+                import time
+                time.sleep(600)
+                return
+            os.kill(os.getpid(), signal.SIGTERM)
+    plan.always("training.step", exc=kill)
+
+
+def _elastic_child_main(config: dict) -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from deeplearning4j_tpu.util import faults
+    from deeplearning4j_tpu.util import metrics as _metrics
+    from deeplearning4j_tpu.parallel.elastic import (ElasticConfig,
+                                                     ElasticTrainer)
+
+    directory = config["checkpoint_dir"]
+    os.makedirs(directory, exist_ok=True)
+    os.environ["DL4JTPU_FLIGHT_DIR"] = directory
+
+    host = config["host"]
+    fleet = tuple(config["fleet"])
+    cfg = ElasticConfig(
+        fleet=fleet, host=host,
+        steps_per_round=config.get("steps_per_round", 2),
+        max_staleness=config.get("max_staleness", 1),
+        lease_s=config.get("lease_s", 1.0),
+        evict_after_s=config.get("evict_after_s"),
+        poll_s=config.get("poll_s", 0.05))
+    trainer = ElasticTrainer(
+        build_net(config.get("seed", 7)), config["store_dir"], cfg,
+        checkpoint_dir=directory, handle_signals=True,
+        watchdog_s=config.get("watchdog_s"))
+
+    plan = faults.FaultPlan()
+    _install_kill_plan(plan, config)
+
+    batch_fn = elastic_batch_fn(config.get("seed", 7),
+                                fleet.index(host))
+    error = None
+    try:
+        with plan.active():
+            trainer.fit(batch_fn, rounds=config["rounds"])
+    except Exception as e:       # report protocol errors via result.json
+        error = f"{type(e).__name__}: {e}"
+
+    from deeplearning4j_tpu.util import flightrecorder as _flight
+    reg = _metrics.REGISTRY
+    transitions = {}
+    ctr = reg.get("membership_transitions_total")
+    if ctr is not None:
+        for s in ctr.snapshot()["series"]:
+            key = f"{s['labels']['event']}:{s['labels']['host']}"
+            transitions[key] = s["value"]
+    rounds_hist = reg.get("sync_round_seconds")
+    result = {
+        "host": host,
+        "round": trainer._round,
+        "final_digest": trainer.final_digest,
+        "agreed": trainer.agreed,
+        "resumed": trainer.resumed,
+        "preempted": trainer.preempted,
+        "incarnation": trainer.coord.incarnation,
+        "iteration_count": getattr(trainer.net, "iteration_count", 0),
+        "transitions": transitions,
+        "sync_rounds_total": (reg.get("sync_rounds_total").value(host=host)
+                              if reg.get("sync_rounds_total") else 0),
+        "sync_round_seconds_sum": (rounds_hist.sum(host=host)
+                                   if rounds_hist else 0.0),
+        "sync_round_seconds_count": (rounds_hist.count(host=host)
+                                     if rounds_hist else 0),
+        # stall/evict attribution straight from the flight recorder, so
+        # the parent can assert WHICH host stalled a round
+        "stalls": [{"round": e.get("round"),
+                    "waiting_on": e.get("waiting_on")}
+                   for e in _flight.events("elastic_stall")],
+        "evictions": [{"host": e.get("host"),
+                       "effective_round": e.get("effective_round")}
+                      for e in _flight.events("elastic_evict")],
+        "error": error,
+    }
+    with open(os.path.join(directory, f"result_{host}.json"), "w") as f:
+        json.dump(result, f)
+    if error is not None:
+        sys.exit(3)
+
+
 def _child_main(config: dict) -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)   # match the test processes
 
-    import signal
-
     from deeplearning4j_tpu.util import faults
     from deeplearning4j_tpu.util.durable import DurableTrainer
 
     directory = config["checkpoint_dir"]
-    kill_mode = config.get("kill_mode")
-    kill_at = config.get("kill_at_iteration")
     # the black box lands next to the checkpoints, where the parent looks
     os.environ["DL4JTPU_FLIGHT_DIR"] = directory
 
@@ -137,22 +397,12 @@ def _child_main(config: dict) -> None:
 
     trainer.net.add_listener(_Collect())
 
+    # the seam fires BEFORE dispatching the (iteration+1)-th step:
+    # iterations 1..kill_at are applied, nothing after ("exit" hard-kills
+    # with nothing draining; "hang" wedges so only a watchdog monitor
+    # thread or a peer's lease can notice)
     plan = faults.FaultPlan()
-    if kill_mode:
-        def kill(payload):
-            # the seam fires BEFORE dispatching the (iteration+1)-th step:
-            # iterations 1..kill_at are applied, nothing after
-            if payload["iteration"] == kill_at:
-                if kill_mode == "exit":
-                    os._exit(9)              # hard kill: nothing drains
-                if kill_mode == "hang":
-                    # a wedged dispatch: only the watchdog's monitor
-                    # thread can notice (this thread never pets again)
-                    import time
-                    time.sleep(600)
-                    return
-                os.kill(os.getpid(), signal.SIGTERM)
-        plan.always("training.step", exc=kill)
+    _install_kill_plan(plan, config)
 
     with plan.active():
         trainer.fit(build_iterator(config.get("seed", 7)),
@@ -171,4 +421,8 @@ def _child_main(config: dict) -> None:
 
 
 if __name__ == "__main__":
-    _child_main(json.loads(sys.argv[1]))
+    _config = json.loads(sys.argv[1])
+    if _config.get("mode") == "elastic":
+        _elastic_child_main(_config)
+    else:
+        _child_main(_config)

@@ -82,13 +82,15 @@ class TestTsne:
         ts = Tsne(perplexity=10, max_iter=250, seed=1)
         emb = ts.fit_transform(pts)
         assert emb.shape == (120, 2)
-        # mean intra-cluster distance < mean inter-cluster distance
-        intra, inter = [], []
-        for i in range(0, 120, 7):
-            for j in range(i + 1, 120, 11):
-                d = np.linalg.norm(emb[i] - emb[j])
-                (intra if labels[i] == labels[j] else inter).append(d)
-        assert np.mean(intra) < 0.5 * np.mean(inter)
+        # t-SNE preserves LOCAL structure: assert 1-NN label purity in
+        # the embedding. (The old global intra/inter distance ratio sat
+        # exactly on its 0.5 threshold — 0.47..0.55 across seeds/thread
+        # schedules — because global distances are the thing t-SNE does
+        # NOT preserve; purity runs 0.88..0.96 with a wide margin.)
+        dist = np.linalg.norm(emb[:, None, :] - emb[None, :, :], axis=-1)
+        np.fill_diagonal(dist, np.inf)
+        purity = float(np.mean(labels[np.argmin(dist, axis=1)] == labels))
+        assert purity > 0.8
         assert ts.kl_divergence is not None and np.isfinite(ts.kl_divergence)
 
     def test_perplexity_validation(self, rng):

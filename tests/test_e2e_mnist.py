@@ -43,8 +43,9 @@ def test_real_mnist_lenet_97pct():
 
 def test_synthetic_mnist_lenet_accuracy():
     """Surrogate path: the class-dependent geometry must be learnable well
-    past chance by the same pipeline (fast budget: 3k train examples)."""
-    acc = _train_and_eval(n_train=3000, n_test=1000, epochs=3)
+    past chance by the same pipeline (fast budget: 3k train examples;
+    2 epochs already reach 1.00 — a wide margin over the 0.90 bar)."""
+    acc = _train_and_eval(n_train=3000, n_test=1000, epochs=2)
     assert acc > 0.90, f"LeNet on synthetic surrogate reached only {acc:.4f}"
 
 
@@ -77,7 +78,9 @@ def test_real_handwritten_digits_lenet_97pct():
     train_it = ArrayDataSetIterator(x_tr, labels[:n_train], batch_size=64)
     test_it = ArrayDataSetIterator(x_te, labels[n_train:], batch_size=256)
     net = MultiLayerNetwork(lenet(learning_rate=1e-3, seed=12345)).init()
-    for _ in range(8):
+    # 6 epochs: 0.9933 on this pinned seed/split (epoch 4 is 0.9798 —
+    # too close to the bar; epoch 8 adds 4s for +0.3pp)
+    for _ in range(6):
         net.fit(train_it)
         train_it.reset()
     acc = net.evaluate(test_it).accuracy()

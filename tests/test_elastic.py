@@ -1,0 +1,423 @@
+"""Elastic bounded-staleness local-SGD: protocol units, in-process
+fleets, and N-process chaos (ROADMAP item 5 / ISSUE 12).
+
+Correctness here is DEFINED under partial failure, so every scenario is
+a deterministic chaos script: evict mid-round, rejoin mid-run, hang vs
+clean exit, replay after a torn process. The in-process tests drive N
+:class:`ElasticTrainer` hosts on threads over an
+``InMemoryCoordinationStore`` (tiny leases, deadline-bounded waits — no
+fixed sleeps); the subprocess tests run the REAL thing through the
+``tests/_kill_harness.py`` fleet mode: N python processes over a
+``FileCoordinationStore`` with per-rank kill plans.
+
+No pytest-timeout plugin is installed, so every wait here is
+harness-bounded: ``run_fleet(timeout=...)`` kills the whole fleet and
+raises on a protocol deadlock, and the thread fleets join with hard
+timeouts — a deadlock fails in seconds, it cannot eat the tier-1 budget.
+"""
+
+import os
+import threading
+import traceback
+
+import numpy as np
+import pytest
+
+import _kill_harness as harness
+from deeplearning4j_tpu.parallel.elastic import (
+    ElasticConfig, ElasticCoordinator, ElasticProtocolError,
+    ElasticTrainer, FileCoordinationStore, InMemoryCoordinationStore,
+    leaves_digest, pack_leaves, unpack_leaves)
+from deeplearning4j_tpu.util.metrics import MetricsRegistry
+from deeplearning4j_tpu.util.resilience import wait_until
+from deeplearning4j_tpu.util import flightrecorder as _flight
+
+ROUNDS = 3
+K = 2           # steps per round
+SEED = 7
+
+
+def _cfg(host, fleet=("h0", "h1"), **kw):
+    kw.setdefault("steps_per_round", K)
+    kw.setdefault("max_staleness", 1)
+    # generous default lease: a first-round jit compile must never read
+    # as a dead host; eviction tests shrink it AFTER the victim is
+    # already provably dead (sequential scripts, no timing races)
+    kw.setdefault("lease_s", 5.0)
+    kw.setdefault("poll_s", 0.01)
+    return ElasticConfig(fleet=fleet, host=host, **kw)
+
+
+class _Die(Exception):
+    pass
+
+
+def _killer(die_round):
+    """Gate that kills the host at the first step of ``die_round``."""
+    def gate(r, step):
+        if r >= die_round:
+            raise _Die()
+    return gate
+
+
+def _batch_fn(host_index, gate=None):
+    fn = harness.elastic_batch_fn(SEED, host_index)
+    if gate is None:
+        return fn
+
+    def gated(r, step):
+        gate(r, step)
+        return fn(r, step)
+    return gated
+
+
+class _Fleet:
+    """Drive N ElasticTrainers on threads; every join is deadline-bounded."""
+
+    def __init__(self):
+        self.results = {}
+        self.errors = {}
+        self.trainers = {}
+        self.threads = {}
+
+    def start(self, trainer, batch_fn, rounds=ROUNDS):
+        host = trainer.cfg.host
+        self.trainers[host] = trainer
+
+        def run():
+            try:
+                trainer.fit(batch_fn, rounds=rounds)
+                self.results[host] = trainer.final_digest
+            except Exception:
+                self.errors[host] = traceback.format_exc()
+
+        t = threading.Thread(target=run, daemon=True)
+        self.threads[host] = t
+        t.start()
+        return trainer
+
+    def join(self, timeout=90.0):
+        for h, t in self.threads.items():
+            t.join(timeout=timeout)
+            assert not t.is_alive(), \
+                f"host {h} did not finish within {timeout}s " \
+                f"(errors so far: {self.errors})"
+        assert not self.errors, self.errors
+        return self.results
+
+
+class TestLeafPacking:
+    def test_roundtrip_and_digest_stability(self, rng):
+        leaves = [rng.normal(size=(3, 4)).astype(np.float32),
+                  rng.normal(size=(5,)).astype(np.float64)]
+        data = pack_leaves(leaves)
+        assert pack_leaves(leaves) == data         # deterministic bytes
+        out = unpack_leaves(data)
+        for a, b in zip(leaves, out):
+            assert a.dtype == b.dtype and np.array_equal(a, b)
+        assert leaves_digest(data) == leaves_digest(pack_leaves(out))
+
+
+class TestCoordinationStores:
+    @pytest.mark.parametrize("make", [
+        InMemoryCoordinationStore,
+        lambda: FileCoordinationStore(
+            os.path.join(__import__("tempfile").mkdtemp(), "s"))])
+    def test_create_once_and_list(self, make):
+        store = make()
+        assert store.put("a/x", b"1") is True
+        assert store.put("a/x", b"2") is False       # create-once
+        assert store.get("a/x") == b"1"
+        assert store.put("a/x", b"3", overwrite=True) is True
+        assert store.get("a/x") == b"3"
+        store.put("a/y", b"4")
+        assert store.list("a") == ["a/x", "a/y"]
+        assert store.get("a/missing") is None
+
+
+class TestMembershipLog:
+    def test_member_at_follows_effective_rounds(self):
+        store = InMemoryCoordinationStore()
+        coord = ElasticCoordinator(
+            store, _cfg("h0", fleet=("h0", "h1", "h2")),
+            registry=MetricsRegistry())
+        assert coord.members_for_round(0) == ("h0", "h1", "h2")
+        coord._append_log("evict", "h1", 2)
+        assert coord.member_at("h1", 1)
+        assert not coord.member_at("h1", 2)
+        assert coord.members_for_round(5) == ("h0", "h2")
+        coord.rejoin("h1", 6, incarnation=2)
+        assert not coord.member_at("h1", 5)
+        assert coord.member_at("h1", 6)
+        assert coord.eviction_of("h1") is None       # newest rec = rejoin
+
+    def test_replayed_contribution_must_match(self, rng):
+        store = InMemoryCoordinationStore()
+        coord = ElasticCoordinator(store, _cfg("h0"),
+                                   registry=MetricsRegistry())
+        leaves = [rng.normal(size=(2, 2)).astype(np.float32)]
+        coord.publish_contribution(0, leaves)
+        coord.publish_contribution(0, leaves)        # idempotent replay
+        with pytest.raises(ElasticProtocolError, match="nondeterministic"):
+            coord.publish_contribution(
+                0, [leaves[0] + np.float32(1.0)])
+
+
+class TestInProcessFleet:
+    """N hosts on threads over one in-memory store."""
+
+    def test_fleet_converges_and_digests_agree(self, tmp_path):
+        store = InMemoryCoordinationStore()
+        fleet = _Fleet()
+        for i, h in enumerate(("h0", "h1")):
+            fleet.start(ElasticTrainer(harness.build_net(SEED), store,
+                                       _cfg(h), registry=MetricsRegistry()),
+                        _batch_fn(i))
+        results = fleet.join()
+        assert len(set(results.values())) == 1
+        for tr in fleet.trainers.values():
+            assert tr.agreed is True
+
+    def test_staleness_zero_matches_sequential_oracle(self):
+        """s=0 is synchronous local SGD: independently simulate the
+        recurrence (per-round deltas, float64 mean, canonical p0 + sum
+        finalization) and require the SAME digest bits."""
+        store = InMemoryCoordinationStore()
+        fleet = _Fleet()
+        for i, h in enumerate(("h0", "h1")):
+            fleet.start(ElasticTrainer(
+                harness.build_net(SEED), store, _cfg(h, max_staleness=0),
+                registry=MetricsRegistry()), _batch_fn(i))
+        results = fleet.join()
+
+        # oracle: two nets stepped sequentially with the same schedule
+        import jax
+        from deeplearning4j_tpu.util.durable import params_digest
+        nets = [harness.build_net(SEED) for _ in range(2)]
+        fns = [_batch_fn(0), _batch_fn(1)]
+        leaves0 = [np.asarray(l) for l in
+                   jax.tree_util.tree_leaves(nets[0].params)]
+        acc = [l.astype(np.float64) for l in leaves0]
+        for r in range(ROUNDS):
+            deltas = []
+            for n, fn in zip(nets, fns):
+                before = [np.asarray(l) for l in
+                          jax.tree_util.tree_leaves(n.params)]
+                for s in range(K):
+                    n.fit_batch(*fn(r, s))
+                after = [np.asarray(l) for l in
+                         jax.tree_util.tree_leaves(n.params)]
+                deltas.append([a - b for a, b in zip(after, before)])
+            red = [(deltas[0][i].astype(np.float64)
+                    + deltas[1][i].astype(np.float64)) / 2.0
+                   for i in range(len(leaves0))]
+            acc = [a + r_ for a, r_ in zip(acc, red)]
+            # s=0: both oracle replicas adopt the corrected state
+            for n, own in zip(nets, deltas):
+                flat, treedef = jax.tree_util.tree_flatten(n.params)
+                corrected = [
+                    (np.asarray(p).astype(np.float64)
+                     + (red[i] - own[i].astype(np.float64))
+                     ).astype(np.asarray(p).dtype)
+                    for i, p in enumerate(flat)]
+                n.params = jax.tree_util.tree_unflatten(treedef, corrected)
+        final = [a.astype(l.dtype) for a, l in zip(acc, leaves0)]
+        flat, treedef = jax.tree_util.tree_flatten(nets[0].params)
+        oracle_digest = params_digest(
+            jax.tree_util.tree_unflatten(treedef, final), None, 0)
+        assert set(results.values()) == {oracle_digest}
+
+    def test_staleness_window_bounds_runahead(self):
+        """h0 alone publishes rounds 0..s, then BLOCKS inside round s
+        awaiting R(0) — the staleness bound; starting h1 releases it."""
+        store = InMemoryCoordinationStore()
+        s = 1
+        fleet = _Fleet()
+        n_stall = len(_flight.events("elastic_stall"))
+        t0 = fleet.start(ElasticTrainer(
+            harness.build_net(SEED), store, _cfg("h0", max_staleness=s,
+                                                 lease_s=30.0),
+            registry=MetricsRegistry()), _batch_fn(0))
+        assert wait_until(
+            lambda: t0._round == s and t0._ctx.get("phase") == "await_reduce",
+            timeout_s=60.0, desc="h0 reaches the staleness bound")
+        assert not wait_until(lambda: t0._round > s, timeout_s=0.5,
+                              desc="h0 must NOT pass the bound"), \
+            "host ran past max_staleness without the peer's rounds"
+        # the blocked round is attributed to h1 in the flight ring
+        stalls = [e for e in _flight.events("elastic_stall")[n_stall:]
+                  if e.get("host") == "h0" and "h1" in e["waiting_on"]]
+        assert stalls and stalls[-1]["round"] == 0
+        fleet.start(ElasticTrainer(
+            harness.build_net(SEED), store, _cfg("h1", max_staleness=s,
+                                                 lease_s=30.0),
+            registry=MetricsRegistry()), _batch_fn(1))
+        results = fleet.join()
+        assert len(set(results.values())) == 1
+
+    def test_dead_host_hard_evicted_and_survivor_completes(self):
+        """h1 dies at round 1 and never comes back: h0 blocks at the
+        staleness bound, hard-evicts h1 after the eviction deadline, and
+        completes the remaining rounds over the surviving membership.
+        Fully sequential — h1 is provably dead before h0 starts."""
+        store = InMemoryCoordinationStore()
+        h1 = ElasticTrainer(harness.build_net(SEED), store,
+                            _cfg("h1", lease_s=0.2, evict_after_s=0.2),
+                            registry=MetricsRegistry())
+        with pytest.raises(_Die):
+            h1.fit(_batch_fn(1, gate=_killer(1)), rounds=ROUNDS)
+        reg0 = MetricsRegistry()
+        n_evict = len(_flight.events("elastic_evict"))
+        h0 = ElasticTrainer(harness.build_net(SEED), store,
+                            _cfg("h0", lease_s=0.2, evict_after_s=0.2),
+                            registry=reg0)
+        h0.fit(_batch_fn(0), rounds=ROUNDS)
+        assert h0.agreed is True and h0.final_digest is not None
+        ctr = reg0.get("membership_transitions_total")
+        assert ctr.value(event="hard_evict", host="h1") >= 1
+        evs = [e for e in _flight.events("elastic_evict")[n_evict:]
+               if e.get("host") == "h1"]
+        assert evs and evs[-1]["effective_round"] == 1
+        # h1 contributed round 0, so round 0 reduced over both hosts;
+        # the rounds it missed reduced over the survivor alone
+        assert sorted(h0.coord.reduce_record(0)["members"]) == ["h0", "h1"]
+        assert h0.coord.reduce_record(ROUNDS - 1)["members"] == ["h0"]
+
+    def test_rejoin_after_hard_evict_syncs_to_fleet_digest(self):
+        """A hard-evicted host restarts after the survivor finished: it
+        rejoins as a NEW member, folds in the published reduction
+        history from p0, and lands on the identical final digest."""
+        store = InMemoryCoordinationStore()
+        h1 = ElasticTrainer(harness.build_net(SEED), store,
+                            _cfg("h1", lease_s=0.2, evict_after_s=0.2),
+                            registry=MetricsRegistry())
+        with pytest.raises(_Die):
+            h1.fit(_batch_fn(1, gate=_killer(1)), rounds=ROUNDS)
+        h0 = ElasticTrainer(harness.build_net(SEED), store,
+                            _cfg("h0", lease_s=0.2, evict_after_s=0.2),
+                            registry=MetricsRegistry())
+        h0.fit(_batch_fn(0), rounds=ROUNDS)
+        # restart h1 (fresh trainer, same host id, no checkpoint):
+        # hard-evicted -> rejoin-as-new, catches up and agrees
+        h1b = ElasticTrainer(harness.build_net(SEED), store,
+                             _cfg("h1", lease_s=0.2, evict_after_s=0.2),
+                             registry=MetricsRegistry())
+        h1b.fit(_batch_fn(1), rounds=ROUNDS)
+        assert h1b.agreed is True
+        assert h1b.final_digest == h0.final_digest
+        assert h1b._member_from >= ROUNDS  # contributed no new rounds
+
+    def test_kill_restore_backfill_is_bit_identical(self, tmp_path):
+        """The determinism claim, in process: a clean 2-host run and a
+        run where h1 dies at round 1 and restarts from its durable
+        snapshot produce the SAME final digest."""
+        def run_pair(store, ckdirs, kill_round=None):
+            fleet = _Fleet()
+            fleet.start(ElasticTrainer(
+                harness.build_net(SEED), store, _cfg("h0", lease_s=60.0),
+                checkpoint_dir=str(ckdirs["h0"]),
+                registry=MetricsRegistry()), _batch_fn(0))
+            if kill_round is not None:
+                h1 = ElasticTrainer(
+                    harness.build_net(SEED), store,
+                    _cfg("h1", lease_s=60.0),
+                    checkpoint_dir=str(ckdirs["h1"]),
+                    registry=MetricsRegistry())
+                with pytest.raises(_Die):
+                    h1.fit(_batch_fn(1, gate=_killer(kill_round)),
+                           rounds=ROUNDS)
+            # (re)start h1 — restores the newest snapshot when present
+            h1b = fleet.start(ElasticTrainer(
+                harness.build_net(SEED), store, _cfg("h1", lease_s=60.0),
+                checkpoint_dir=str(ckdirs["h1"]),
+                registry=MetricsRegistry()), _batch_fn(1))
+            results = fleet.join()
+            return results, h1b
+
+        dirs_a = {h: tmp_path / "a" / h for h in ("h0", "h1")}
+        clean, _ = run_pair(InMemoryCoordinationStore(), dirs_a)
+        assert len(set(clean.values())) == 1
+        dirs_b = {h: tmp_path / "b" / h for h in ("h0", "h1")}
+        killed, h1b = run_pair(InMemoryCoordinationStore(), dirs_b,
+                               kill_round=1)
+        assert h1b.resumed is True, "h1 must restore its durable snapshot"
+        assert set(killed.values()) == set(clean.values()), \
+            "kill/restore run diverged from the clean run"
+
+
+@pytest.mark.chaos
+class TestFleetChaosSubprocess:
+    """The real thing: N python processes over a FileCoordinationStore,
+    per-rank kill plans, parent-as-scheduler restarts. Hard-bounded by
+    run_fleet(timeout=...)."""
+
+    def test_sigterm_kill_restart_bit_identical_to_clean_run(self, tmp_path):
+        store = str(tmp_path / "store")
+        clean = harness.run_fleet(harness.elastic_fleet_configs(
+            2, store, str(tmp_path / "clean"), rounds=4,
+            steps_per_round=2, max_staleness=1, lease_s=2.0),
+            timeout=150)
+        digests = {v["result"]["final_digest"] for v in clean.values()}
+        assert len(digests) == 1 and None not in digests
+        assert all(v["result"]["agreed"] for v in clean.values())
+
+        # same schedule, but h1 is SIGTERMed at local iteration 4 (start
+        # of round 2) and rescheduled 3s later — longer than the lease,
+        # so the survivor OBSERVES the dropout; survivors keep stepping
+        # (staleness window), the restart restores its snapshot,
+        # replays, and backfills the rounds the fleet is blocked on
+        cfgs = harness.elastic_fleet_configs(
+            2, str(tmp_path / "store2"), str(tmp_path / "kill"),
+            rounds=4, steps_per_round=2, max_staleness=1, lease_s=1.5,
+            evict_after_s=120.0,        # rejoin must beat hard eviction
+            kill_plans={1: {"kill_mode": "sigterm",
+                            "kill_at_iteration": 4}})
+        restart = {k: v for k, v in cfgs[1].items()
+                   if k not in ("kill_mode", "kill_at_iteration")}
+        out = harness.run_fleet(cfgs, timeout=200,
+                                restarts={"h1": restart},
+                                restart_delay_s=3.0)
+        for h, v in out.items():
+            assert v["rc"] == 0, (h, v["stderr"][-2000:])
+            assert v["result"]["error"] is None, v["result"]
+        assert out["h1"]["restarted"] and out["h1"]["result"]["resumed"]
+        assert out["h1"]["result"]["incarnation"] == 2
+        kill_digests = {v["result"]["final_digest"] for v in out.values()}
+        assert kill_digests == digests, \
+            "fleet with kill+rejoin diverged from uninterrupted fleet"
+        # the survivor OBSERVED the dropout and the rejoin
+        tr = out["h0"]["result"]["transitions"]
+        assert tr.get("evict:h1", 0) >= 1, tr
+        assert tr.get("rejoin:h1", 0) >= 1, tr
+
+    def test_hang_and_hard_kill_evicted_within_deadline(self, tmp_path):
+        """h1 wedges (hang) mid-round and h2 hard-exits: the survivor
+        blocks no longer than the eviction deadline per failure, evicts
+        both, completes all rounds, and the flight recorder names who
+        stalled each blocked round."""
+        cfgs = harness.elastic_fleet_configs(
+            3, str(tmp_path / "store"), str(tmp_path / "fleet"),
+            rounds=4, steps_per_round=2, max_staleness=1,
+            lease_s=1.5, evict_after_s=1.0,
+            kill_plans={1: {"kill_mode": "hang", "kill_at_iteration": 2},
+                        2: {"kill_mode": "exit", "kill_at_iteration": 4}})
+        out = harness.run_fleet(cfgs, timeout=200)
+        h0 = out["h0"]
+        assert h0["rc"] == 0, h0["stderr"][-2000:]
+        res = h0["result"]
+        assert res["error"] is None, res
+        assert res["round"] == 4 and res["agreed"] is True
+        assert res["sync_rounds_total"] == 4
+        # both failures observed and hard-evicted
+        assert res["transitions"].get("hard_evict:h1", 0) >= 1
+        assert res["transitions"].get("hard_evict:h2", 0) >= 1
+        evicted = {e["host"] for e in res["evictions"]}
+        assert evicted == {"h1", "h2"}
+        # stall attribution names the wedged hosts
+        waited_on = {h for s in res["stalls"] for h in s["waiting_on"]}
+        assert waited_on <= {"h1", "h2"} and waited_on
+        # h1 hung at local iteration 2 = mid round 1, so its last
+        # publish was round 0: eviction effective round 1
+        h1_ev = [e for e in res["evictions"] if e["host"] == "h1"]
+        assert h1_ev[0]["effective_round"] == 1
+        assert out["h1"]["rc"] == "killed_hung"

@@ -138,11 +138,13 @@ class TestClassicZoo:
         net = MultiLayerNetwork(vgg16(height=32, width=32, n_classes=4,
                                       updater="adam", learning_rate=1e-3,
                                       dtype="float32")).init()
-        x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
-        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        # batch 4 / 6 steps: VGG16 CPU steps are ~2s each and the test
+        # pins "training moves the loss", not a convergence curve
+        x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
         # dropout makes single-step losses noisy: compare first vs the mean
         # of the last three
-        losses = [float(np.asarray(net.fit_batch(x, y))) for _ in range(12)]
+        losses = [float(np.asarray(net.fit_batch(x, y))) for _ in range(6)]
         assert np.mean(losses[-3:]) < losses[0]
 
     def test_deep_autoencoder_reconstructs_curves(self):

@@ -76,7 +76,7 @@ class TestSyncDataParallel:
             pw.fit_batch(x, y)
 
         for a, b in zip(_leaves(ref.params), _leaves(net.params)):
-            assert np.allclose(a, b, atol=1e-5), "sync dp diverged from single-device"
+            assert np.allclose(a, b, atol=1e-4), "sync dp diverged from single-device"
 
     def test_batchnorm_global_stats(self, rng):
         """BN under SPMD: batch statistics are computed over the GLOBAL batch
@@ -98,7 +98,7 @@ class TestSyncDataParallel:
         for _ in range(3):
             pw.fit_batch(x, y)
         for a, b in zip(_leaves(ref.state), _leaves(net.state)):
-            assert np.allclose(a, b, atol=1e-5), "BN running stats diverged"
+            assert np.allclose(a, b, atol=1e-4), "BN running stats diverged"
 
     def test_fit_iterator(self, rng):
         x, y = _data(rng, n=96)

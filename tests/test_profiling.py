@@ -108,18 +108,19 @@ class TestCaptureGuard:
         def long_capture():
             with profiling.trace(str(tmp_path / "a")):
                 started.set()
-                done.wait(5.0)
+                done.wait(30.0)
 
         t = threading.Thread(target=long_capture, daemon=True)
         t.start()
-        assert started.wait(5.0)
+        # 30s bound, not 5: profiler start is slow under suite load
+        assert started.wait(30.0)
         try:
             assert profiling.capture_in_progress()
             with pytest.raises(profiling.ProfilerBusy):
                 profiling.capture_trace(0.01, str(tmp_path / "b"))
         finally:
             done.set()
-            t.join(5.0)
+            t.join(30.0)
         # guard released: a new capture works again
         profiling.capture_trace(0.01, str(tmp_path / "c"))
 
