@@ -754,18 +754,21 @@ def bench_decode() -> dict:
                       p=[0.35, 0.35, 0.1, 0.2])
     arrivals = np.cumsum(rng.exponential(iat_s, n_req))
 
-    def poisson_run(registry, **engine_kw):
+    def poisson_run(registry, tracer=None, engine=None, **engine_kw):
         """One continuous-batching run over the shared schedule; every
-        mode gets its own registry so sync/token accounting is clean."""
-        engine = PagedDecodeEngine(net, max_batch=lanes,
-                                   page_size=page_size,
-                                   pages_per_seq=pages_per_seq,
-                                   prefill_chunk=lp, registry=registry,
-                                   **engine_kw)
-        engine.warmup()                 # compile the whole trace ladder
+        mode gets its own registry so sync/token accounting is clean.
+        ``engine`` reuses an already-warm engine (same compiled ladder)
+        for an A/B where only the scheduler config differs."""
+        if engine is None:
+            engine = PagedDecodeEngine(net, max_batch=lanes,
+                                       page_size=page_size,
+                                       pages_per_seq=pages_per_seq,
+                                       prefill_chunk=lp,
+                                       registry=registry, **engine_kw)
+            engine.warmup()             # compile the whole trace ladder
         sched = DecodeScheduler(engine, registry=registry,
                                 max_queue=n_req + 8,
-                                request_timeout_s=600.0)
+                                request_timeout_s=600.0, tracer=tracer)
         t0 = time.perf_counter()
         reqs = []
         for i in range(n_req):
@@ -789,10 +792,49 @@ def bench_decode() -> dict:
                 "tpot_ms": 1000 * float(np.mean(tpots)),
                 "host_syncs_per_token": syncs / max(tokens, 1),
                 "registry": registry,
+                "engine": engine,
+                "reqs": reqs,
                 "outputs": [r.tokens for r in reqs]}
 
     # ---- A: FUSED continuous batching (owns the process registry) ---
     fused = poisson_run(_metrics.REGISTRY, block_len=block_len)
+    # HEADLINE-run registry snapshots, captured before the A/B reruns
+    # below keep writing into the same process registry: totals (goodput
+    # split, evicted pages) and the tick-split means must describe the
+    # headline fused run alone, not 6 stacked schedules
+    _goodput = _metrics.REGISTRY.get("decode_goodput_tokens_total")
+    goodput_met = int(_goodput.value(slo="met"))
+    goodput_missed = int(_goodput.value(slo="missed"))
+    _evicted = _metrics.REGISTRY.get("kv_pages_evicted_total")
+    kv_evicted_headline = (int(_evicted.value())
+                           if _evicted is not None else None)
+    _occ = _metrics.REGISTRY.get("decode_batch_occupancy")
+    occ_headline = ((_occ.sum(), _occ.count())
+                    if _occ is not None else (0.0, 0))
+    _tick = _metrics.REGISTRY.get("decode_host_tick_seconds")
+    tick_headline = (_tick.snapshot()["series"]
+                     if _tick is not None else [])
+    # ---- A': same engine + schedule with per-request tracing ON — the
+    # measured cost of the request-timeline instrumentation (PERF
+    # acceptance: ≤1% on tokens/s) and the source of the sample
+    # timeline + TTFT decomposition in this payload. One Poisson run is
+    # ~0.3s of wall, so single-run tokens/s jitters by several percent;
+    # the A/B compares BEST-of-3 per side on the shared warm engine
+    from deeplearning4j_tpu.util import timeline as _timeline
+    from deeplearning4j_tpu.util.tracing import Tracer
+    fused_best = fused["tokens_per_s"]
+    for _ in range(2):
+        rep = poisson_run(_metrics.REGISTRY, engine=fused["engine"])
+        assert rep["outputs"] == fused["outputs"]
+        fused_best = max(fused_best, rep["tokens_per_s"])
+    tracer = Tracer(max_spans=100000)
+    traced, traced_best = None, 0.0
+    for _ in range(3):
+        t = poisson_run(_metrics.REGISTRY, tracer=tracer,
+                        engine=fused["engine"])
+        assert t["outputs"] == fused["outputs"]
+        if t["tokens_per_s"] > traced_best:
+            traced_best, traced = t["tokens_per_s"], t
     # ---- B: the PR-6 host-ticked baseline ----------------------------
     ticked = poisson_run(MetricsRegistry())
     # ---- C: speculative (target-as-draft acceptance ceiling) ---------
@@ -854,7 +896,6 @@ def bench_decode() -> dict:
     wave_ttfts.sort()
 
     assert cont_tokens == wave_tokens == int(lens.sum())
-    occ = _metrics.REGISTRY.get("decode_batch_occupancy")
     out = {"continuous_tokens_per_s": round(cont["tokens_per_s"], 1),
            "ticked_tokens_per_s": round(ticked["tokens_per_s"], 1),
            "spec_tokens_per_s": round(spec["tokens_per_s"], 1),
@@ -885,19 +926,58 @@ def bench_decode() -> dict:
            "output_lens": "4/8/16/96 @ .35/.35/.1/.2",
            "total_tokens": cont_tokens,
            "arrival_iat_ms": round(1000 * iat_s, 1)}
-    if occ is not None and occ.count():
-        out["mean_decode_occupancy"] = round(occ.sum() / occ.count(), 2)
-    evicted = _metrics.REGISTRY.get("kv_pages_evicted_total")
-    if evicted is not None:
-        out["kv_pages_evicted"] = int(evicted.value())
+    occ_sum, occ_count = occ_headline
+    if occ_count:
+        out["mean_decode_occupancy"] = round(occ_sum / occ_count, 2)
+    if kv_evicted_headline is not None:
+        out["kv_pages_evicted"] = kv_evicted_headline
     # the measured host-tick split (ISSUE 11 satellite): mean seconds per
-    # component across the fused run's scheduler ticks
-    tick = _metrics.REGISTRY.get("decode_host_tick_seconds")
-    if tick is not None:
-        for s in tick.snapshot()["series"]:
-            if s["count"]:
-                out[f"tick_{s['labels']['component']}_mean_ms"] = round(
-                    1000 * s["sum"] / s["count"], 4)
+    # component across the HEADLINE fused run's scheduler ticks (the
+    # snapshot predates the A/B reruns)
+    for s in tick_headline:
+        if s["count"]:
+            out[f"tick_{s['labels']['component']}_mean_ms"] = round(
+                1000 * s["sum"] / s["count"], 4)
+    # ---- request-timeline observability (ISSUE 13) -------------------
+    # goodput next to the throughput row: served tokens by SLO outcome
+    out["goodput_tokens_met"] = goodput_met
+    out["goodput_tokens_missed"] = goodput_missed
+    # measured tracing cost: same engine, same schedule, spans on vs
+    # off, best-of-3 each side
+    out["traced_tokens_per_s"] = round(traced_best, 1)
+    out["tracing_overhead_pct"] = round(
+        100.0 * (1.0 - traced_best / fused_best), 2)
+    # the TTFT decomposition must SUM to the measured TTFT (acceptance:
+    # within 5%); report the worst request so regressions are visible
+    errs = []
+    for r in traced["reqs"]:
+        if r.ttft_breakdown and r.t_first_token is not None:
+            ttft = r.t_first_token - r.t_submit
+            if ttft > 0:
+                errs.append(
+                    abs(sum(r.ttft_breakdown.values()) - ttft) / ttft)
+    if errs:
+        out["ttft_decomposition_max_err_pct"] = round(
+            100.0 * max(errs), 4)
+        mean_bd = {k: 0.0 for k in
+                   ("queue_wait", "prefill", "compile", "dispatch")}
+        n_bd = 0
+        for r in traced["reqs"]:
+            if r.ttft_breakdown:
+                n_bd += 1
+                for k, v in r.ttft_breakdown.items():
+                    mean_bd[k] += v
+        out["ttft_breakdown_mean_ms"] = {
+            k: round(1000 * v / max(n_bd, 1), 3)
+            for k, v in mean_bd.items()}
+    # one fully-rendered request timeline (the longest request) as the
+    # payload's worked example of the span tree
+    timelines = _timeline.request_timelines(tracer)
+    if timelines:
+        sample = max(timelines,
+                     key=lambda t: t["attributes"].get("tokens", 0))
+        out["sample_request_timeline"] = json.loads(
+            json.dumps(sample, default=repr))
     return out
 
 

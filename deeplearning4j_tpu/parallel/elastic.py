@@ -77,12 +77,14 @@ older than the newest fleet snapshot.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import io
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -92,6 +94,7 @@ import numpy as np
 from ..util import faults as _faults
 from ..util import flightrecorder as _flight
 from ..util import metrics as _metrics
+from ..util import tracing as _tracing
 from ..util.resilience import SYSTEM_CLOCK, Clock, Deadline
 from .distributed import agree_on_digest
 
@@ -501,6 +504,12 @@ class ElasticCoordinator:
                     **extra) -> dict:
         recs = self.membership_log()
         seq = (int(recs[-1]["seq"]) + 1) if recs else 1
+        # membership changes are written from inside a round/fit span:
+        # stamping the active trace id lets the timeline collector tie
+        # an eviction to the exact round trace that observed it
+        span = _tracing.active_span()
+        if span is not None:
+            extra.setdefault("trace_id", span.trace_id)
         while True:
             doc = {"seq": seq, "event": event, "host": host,
                    "effective_round": int(effective_round),
@@ -617,6 +626,33 @@ class ElasticCoordinator:
     def reduce_record(self, round_: int) -> Optional[dict]:
         return self.store.get_json(f"{self._round_dir(round_)}/REDUCE.json")
 
+    # -- round trace records (attribution, not protocol) ---------------
+
+    def publish_trace(self, round_: int, spans: Sequence[dict]) -> None:
+        """Export this host's round-``round_`` spans next to the REDUCE
+        record (``trace_<host>.json``). Overwrite-mode and best-effort:
+        a replayed round records the replay's timings, and a failing
+        export must never fail the round it describes."""
+        try:
+            self.store.put_json(
+                f"{self._round_dir(round_)}/trace_{self.host}.json",
+                {"host": self.host, "round": int(round_),
+                 "incarnation": self.incarnation, "spans": list(spans)},
+                overwrite=True)
+        except Exception:
+            logger.exception("elastic: trace export for round %d failed",
+                             round_)
+
+    def trace_records(self, round_: int) -> List[dict]:
+        out = []
+        for key in self.store.list(self._round_dir(round_)):
+            name = os.path.basename(key)
+            if name.startswith("trace_") and name.endswith(".json"):
+                doc = self.store.get_json(key)
+                if doc is not None:
+                    out.append(doc)
+        return out
+
     def _compute_reduction(self, round_: int,
                            members: Sequence[str]) -> List[np.ndarray]:
         """Mean of the members' deltas in fleet order, accumulated in
@@ -728,12 +764,21 @@ class ElasticTrainer:
                  checkpoint_dir: Optional[str] = None,
                  registry=None, watchdog_s: Optional[float] = None,
                  handle_signals: bool = False, keep: int = 3,
-                 stepper_factory: Optional[Callable] = None):
+                 stepper_factory: Optional[Callable] = None,
+                 tracer: Optional[_tracing.Tracer] = None):
         from ..util.durable import CheckpointStore
         if isinstance(store, str):
             store = FileCoordinationStore(store)
         self.cfg = cfg
         self.registry = registry
+        # per-trainer tracer named by the LOGICAL host id (not the
+        # machine hostname): merged fleet timelines attribute phases to
+        # fleet members. Root parent comes from DL4JTPU_TRACEPARENT when
+        # the cluster scheduler (or the chaos harness) set one, so every
+        # host's spans share the fleet trace id.
+        self.tracer = tracer if tracer is not None \
+            else _tracing.Tracer(host=cfg.host, registry=registry)
+        self._round_spans: List[_tracing.Span] = []
         self.coord = ElasticCoordinator(store, cfg, registry=registry)
         self.watchdog_s = watchdog_s
         self.handle_signals = handle_signals
@@ -803,6 +848,17 @@ class ElasticTrainer:
         if self._watchdog is not None:
             self._watchdog.pet()
 
+    @contextlib.contextmanager
+    def _span(self, name: str, **attrs):
+        """A tracer span collected into the current round's export set."""
+        with self.tracer.span(name, attributes=attrs) as s:
+            self._round_spans.append(s)
+            yield s
+
+    def _record_span(self, name: str, seconds: float, **attrs) -> None:
+        self._round_spans.append(
+            self.tracer.record(name, seconds, attributes=attrs))
+
     # -- rejoin planning -----------------------------------------------
 
     def _plan_membership(self, rounds: int) -> None:
@@ -868,12 +924,22 @@ class ElasticTrainer:
         evict_deadlines: Dict[str, Deadline] = {}
         last_stall: Tuple = ()
         while True:
+            t_try = cfg.clock.monotonic()
             red = self.coord.try_reduce(round_)
             if red is not None:
-                waited = cfg.clock.monotonic() - started
+                now = cfg.clock.monotonic()
+                reduce_s = now - t_try
+                waited = now - started
                 if waited > cfg.poll_s:
                     round_wait_seconds_histogram(self.registry).observe(
                         waited, host=cfg.host)
+                # the round timeline's wait/reduce decomposition: wait =
+                # blocked polling for peers (attributed to the missing
+                # hosts), reduce = the successful mean + digest check
+                self._record_span("wait", waited - reduce_s,
+                                  round=round_,
+                                  waiting_on=list(last_stall))
+                self._record_span("reduce", reduce_s, round=round_)
                 return red
             if self._stop_requested():
                 return None
@@ -927,51 +993,63 @@ class ElasticTrainer:
         t0 = cfg.clock.monotonic()
         self._round = r
         self._ctx.update(round=r, phase="steps", waiting_on=[])
-        self._held = self._capture()
-        if cfg.checkpoint_every_rounds and \
-                r % cfg.checkpoint_every_rounds == 0:
-            self._write_snapshot(self._held)
-        self.coord.heartbeat(r)
-        p_before = _net_param_leaves(self.net)
+        self._round_spans = []
         replay = cfg.host in self.coord.published_hosts(r)
-        for step in range(cfg.steps_per_round):
-            it = getattr(self.net, "iteration_count", 0)
-            _faults.check("training.step",
-                          {"iteration": it, "round": r, "host": cfg.host,
-                           "elastic": True})
-            if self._stop_requested():
-                return False        # round restarts from _held on resume
-            batch = batch_fn(r, step)
-            self.stepper.fit_batch(*batch)
-            self._pet()
-            self.coord.heartbeat(r)     # rate-limited; bounds the gap
-                                        # to one step even in long rounds
-        delta = [a - b for a, b in zip(_net_param_leaves(self.net),
-                                       p_before)]
-        self.coord.publish_contribution(r, delta)
-        self._own_deltas[r] = delta
-        self.coord.heartbeat(r + 1, force=True)
-        j = r - cfg.max_staleness
-        while self._applied_next <= j:
-            self._ctx.update(phase="await_reduce", waiting_on=[])
-            red = self._await_reduce(self._applied_next)
-            if red is None:
-                return False
-            self._apply_correction(self._applied_next, red)
-            self._applied_next += 1
-        dt = cfg.clock.monotonic() - t0
-        rounds_counter(self.registry).inc(host=cfg.host)
-        round_seconds_histogram(self.registry).observe(dt, host=cfg.host)
-        view = self.coord.fleet_view()
-        live_rounds = [v["round"] for h, v in view.items()
-                       if v["alive"] and not v["done"] and h != cfg.host
-                       and v["round"] >= 0]
-        staleness_gauge(self.registry).set(
-            (r + 1) - min(live_rounds) if live_rounds else 0,
-            host=cfg.host)
-        _flight.record("elastic_round", host=cfg.host, round=r,
-                       seconds=round(dt, 4), steps=cfg.steps_per_round,
-                       replay=bool(replay))
+        with self._span("elastic.round", round=r, replay=bool(replay)):
+            self._held = self._capture()
+            if cfg.checkpoint_every_rounds and \
+                    r % cfg.checkpoint_every_rounds == 0:
+                self._write_snapshot(self._held)
+            self.coord.heartbeat(r)
+            p_before = _net_param_leaves(self.net)
+            with self._span("local_steps", round=r,
+                            steps=cfg.steps_per_round):
+                for step in range(cfg.steps_per_round):
+                    it = getattr(self.net, "iteration_count", 0)
+                    _faults.check("training.step",
+                                  {"iteration": it, "round": r,
+                                   "host": cfg.host, "elastic": True})
+                    if self._stop_requested():
+                        return False    # round restarts from _held
+                    batch = batch_fn(r, step)
+                    self.stepper.fit_batch(*batch)
+                    self._pet()
+                    self.coord.heartbeat(r)   # rate-limited; bounds the
+                                              # gap to one step even in
+                                              # long rounds
+            delta = [a - b for a, b in zip(_net_param_leaves(self.net),
+                                           p_before)]
+            with self._span("publish", round=r):
+                self.coord.publish_contribution(r, delta)
+            self._own_deltas[r] = delta
+            self.coord.heartbeat(r + 1, force=True)
+            j = r - cfg.max_staleness
+            while self._applied_next <= j:
+                self._ctx.update(phase="await_reduce", waiting_on=[])
+                red = self._await_reduce(self._applied_next)
+                if red is None:
+                    return False
+                with self._span("apply", round=self._applied_next):
+                    self._apply_correction(self._applied_next, red)
+                self._applied_next += 1
+            dt = cfg.clock.monotonic() - t0
+            rounds_counter(self.registry).inc(host=cfg.host)
+            round_seconds_histogram(self.registry).observe(dt,
+                                                           host=cfg.host)
+            view = self.coord.fleet_view()
+            live_rounds = [v["round"] for h, v in view.items()
+                           if v["alive"] and not v["done"] and h != cfg.host
+                           and v["round"] >= 0]
+            staleness_gauge(self.registry).set(
+                (r + 1) - min(live_rounds) if live_rounds else 0,
+                host=cfg.host)
+            _flight.record("elastic_round", host=cfg.host, round=r,
+                           seconds=round(dt, 4), steps=cfg.steps_per_round,
+                           replay=bool(replay))
+        # export the finished round's spans next to its REDUCE record —
+        # the timeline collector's per-host input for this round
+        self.coord.publish_trace(r, [s.to_dict()
+                                     for s in self._round_spans])
         return True
 
     # -- finish: tail flush + digest barrier ---------------------------
@@ -1069,8 +1147,22 @@ class ElasticTrainer:
                     **_faults.seam_context(),
                     "elastic": dict(self._ctx)})
             self._watchdog.arm()
+        # the host's root span: parented on the fleet trace the spawning
+        # scheduler handed us (DL4JTPU_TRACEPARENT), so every member's
+        # round spans share one trace id and merge into one timeline
+        fit_ctx = self.tracer.span(
+            "elastic.fit", parent=_tracing.env_context(),
+            attributes={"rounds": rounds,
+                        "incarnation": self.coord.incarnation,
+                        "resumed": self.resumed})
+        fit_span = fit_ctx.__enter__()
+        fit_exc: Tuple = (None, None, None)
         try:
             self._plan_membership(rounds)
+            # rejoin-as-new flips `resumed` inside _plan_membership —
+            # re-stamp so the exported root span reports how this
+            # incarnation actually started
+            fit_span.set_attribute("resumed", self.resumed)
             self.coord.heartbeat(self._round, force=True)
             self.coord.fleet_view()
             # catch up the reduction history this chain has not yet
@@ -1100,7 +1192,16 @@ class ElasticTrainer:
                 self._write_snapshot(self._held)
                 _flight.record("elastic_preempted", host=self.cfg.host,
                                round=self._round)
+        except BaseException:
+            # captured explicitly, NOT via sys.exc_info() in the
+            # finally — a caller invoking fit() from inside its own
+            # `except` block (the restart-after-preemption flow) has a
+            # live outer exception that would falsely mark a clean
+            # run's root span as error
+            fit_exc = sys.exc_info()
+            raise
         finally:
+            fit_ctx.__exit__(*fit_exc)
             if self._watchdog is not None:
                 self._watchdog.disarm()
             if self._preemption is not None:

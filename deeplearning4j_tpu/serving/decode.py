@@ -92,6 +92,7 @@ from ..nn.conf.layers import EmbeddingSequenceLayer
 from ..util import faults as _faults
 from ..util import flightrecorder as _flight
 from ..util import metrics as _metrics
+from ..util import tracing as _tracing
 from ..util import xla as _xla
 from ..util.resilience import SYSTEM_CLOCK, Clock, Deadline
 from .kv_cache import PagedKVArena
@@ -116,12 +117,22 @@ class SchedulerDraining(RuntimeError):
 class DecodeRequest:
     """Handle for one generative request: the scheduler appends tokens as
     they are produced and signals ``event`` on finish. ``finish_reason``
-    ∈ {eos, max_tokens, deadline, error, shutdown}."""
+    ∈ {eos, max_tokens, deadline, error, shutdown}.
+
+    ``ttft_breakdown`` (stamped at the first token, when the scheduler
+    has a clock that advances) decomposes the measured TTFT into
+    components that sum to it: ``queue_wait`` (submit → lane admission),
+    ``prefill`` (this request's own prefill-dispatch wall, compile
+    excluded), ``compile`` (fresh-trace compiles its prefill ticks
+    paid — 0 after ``warmup()``), and ``dispatch`` (the remainder: the
+    shared continuous-batching ticks' other dispatches + host
+    bookkeeping between admission and the first token)."""
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "eos_id",
                  "deadline", "rng", "tokens", "finish_reason", "error",
-                 "event", "t_submit", "t_first_token", "t_done",
-                 "top_k", "top_p")
+                 "event", "t_submit", "t_admit", "t_first_token",
+                 "t_done", "top_k", "top_p", "span", "queue_span",
+                 "ttft_breakdown")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float, eos_id: Optional[int],
@@ -140,8 +151,12 @@ class DecodeRequest:
         self.error: Optional[str] = None
         self.event = threading.Event()
         self.t_submit = t_submit
+        self.t_admit: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        self.span = None            # request-root tracing span
+        self.queue_span = None      # child span covering queue wait
+        self.ttft_breakdown: Optional[Dict[str, float]] = None
 
     @property
     def done(self) -> bool:
@@ -158,7 +173,8 @@ _PREFILL, _DECODE = "prefill", "decode"
 
 
 class _Sequence:
-    __slots__ = ("req", "lane", "state", "cursor", "last_token")
+    __slots__ = ("req", "lane", "state", "cursor", "last_token",
+                 "prefill_s", "compile_s")
 
     def __init__(self, req: DecodeRequest, lane: int):
         self.req = req
@@ -166,6 +182,8 @@ class _Sequence:
         self.state = _PREFILL
         self.cursor = 0              # prompt tokens already prefilled
         self.last_token = 0          # next token to feed in decode
+        self.prefill_s = 0.0         # own prefill dispatch wall (TTFT)
+        self.compile_s = 0.0         # compile wall its ticks paid
 
 
 class PagedDecodeEngine:
@@ -508,6 +526,13 @@ class PagedDecodeEngine:
         if self.draft_arena is not None:
             self.draft_arena.reset_pools()
 
+    def _compile_wall(self) -> float:
+        """Total compile wall this engine's registry has seen — deltas
+        around a dispatch attribute fresh-trace compiles (a bucket
+        ``warmup()`` missed) to the requests that paid for them."""
+        h = self.registry.get("xla_compile_seconds")
+        return 0.0 if h is None else h.total_sum()
+
     def _note_dispatch(self, t0: float, kind: str,
                        sync: bool = True) -> None:
         if self._warming:
@@ -780,6 +805,15 @@ class DecodeScheduler:
         self._m_draft = reg.counter(
             "decode_draft_tokens_total",
             "Speculative draft tokens, by verify outcome", ("result",))
+        # goodput, not just throughput: tokens that were SERVED split by
+        # whether their request met its SLO deadline — a saturated
+        # scheduler can post high decode_tokens_total while every
+        # request deadline-expires half-answered
+        self._m_goodput = reg.counter(
+            "decode_goodput_tokens_total",
+            "Generated tokens by SLO outcome of their request: met "
+            "(finished by eos/max_tokens within its deadline) vs missed "
+            "(deadline/error/shutdown)", ("slo",))
         # weakly bound, like the arena gauges: a retired scheduler (and
         # through it the engine, params, and pools) must stay
         # collectable even on a shared registry — a dead ref raises,
@@ -809,13 +843,21 @@ class DecodeScheduler:
                temperature: float = 0.0, eos_id: Optional[int] = None,
                timeout_s: Optional[float] = None,
                seed: Optional[int] = None, top_k: int = 0,
-               top_p: float = 1.0) -> DecodeRequest:
+               top_p: float = 1.0, trace_ctx=None) -> DecodeRequest:
         """Accept one generative request into the bounded queue. Raises
         :class:`SchedulerDraining` / :class:`SchedulerSaturated` (the
         shed paths — recorded by reason) instead of queueing unbounded
         latency. ``top_k``/``top_p`` filter temperature sampling (the
         one semantics shared by the host sampler and the fused device
-        loop — see ``ops/sampling.py``); ignored when greedy."""
+        loop — see ``ops/sampling.py``); ignored when greedy.
+
+        With a tracer attached, every request gets a root span
+        (``decode.request``) with child spans for queue wait, each
+        prefill chunk, and each decode/spec block dispatch — the
+        per-request timeline ``/debug/timeline`` and
+        ``util.timeline.request_timelines`` render. ``trace_ctx`` (a
+        traceparent string or extracted SpanContext, e.g. from an HTTP
+        header) parents the root span on the caller's trace."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -845,23 +887,45 @@ class DecodeScheduler:
                      else self.request_timeout_s, self.clock),
             rng, self.clock.monotonic(), top_k=int(top_k),
             top_p=float(top_p))
-        with self._cond:
-            # flags checked under the lock: a submit racing stop() must
-            # either land before the shutdown flush or be refused — never
-            # strand a request in a queue nothing will ever drain
-            if self._draining or self._stopped:
-                self._m_shed.inc(reason="draining")
-                _flight.record("decode_shed", reason="draining")
-                raise SchedulerDraining("decode scheduler is draining")
-            if len(self._queue) >= self.max_queue:
-                self._m_shed.inc(reason="decode_queue_full")
-                _flight.record("decode_shed", reason="decode_queue_full",
-                               queue_depth=len(self._queue))
-                raise SchedulerSaturated(
-                    "decode queue full", retry_after=1.0)
-            self._queue.append(req)
-            self._cond.notify_all()
+        if self.tracer is not None:
+            if isinstance(trace_ctx, str):
+                trace_ctx = _tracing.extract(trace_ctx)
+            req.span = self.tracer.start(
+                "decode.request", parent=trace_ctx,
+                attributes={"prompt_len": int(prompt.size),
+                            "max_new_tokens": n_new})
+            req.queue_span = self.tracer.start("queue", parent=req.span)
+        try:
+            with self._cond:
+                # flags checked under the lock: a submit racing stop()
+                # must either land before the shutdown flush or be
+                # refused — never strand a request in a queue nothing
+                # will ever drain
+                if self._draining or self._stopped:
+                    self._m_shed.inc(reason="draining")
+                    _flight.record("decode_shed", reason="draining")
+                    raise SchedulerDraining("decode scheduler is draining")
+                if len(self._queue) >= self.max_queue:
+                    self._m_shed.inc(reason="decode_queue_full")
+                    _flight.record("decode_shed",
+                                   reason="decode_queue_full",
+                                   queue_depth=len(self._queue))
+                    raise SchedulerSaturated(
+                        "decode queue full", retry_after=1.0)
+                self._queue.append(req)
+                self._cond.notify_all()
+        except Exception:
+            self._end_request_spans(req, "shed")
+            raise
         return req
+
+    @staticmethod
+    def _end_request_spans(req: DecodeRequest,
+                           status: Optional[str] = None) -> None:
+        if req.queue_span is not None:
+            req.queue_span.end(status)
+        if req.span is not None:
+            req.span.end(status)
 
     # -- the continuous-batching tick ---------------------------------
 
@@ -934,6 +998,11 @@ class DecodeScheduler:
                 break
             with self._cond:
                 self._queue.popleft()
+            req.t_admit = self.clock.monotonic()
+            if req.queue_span is not None:
+                req.queue_span.set_attribute("lane", lane)
+                req.queue_span.end()
+                req.queue_span = None
             self._active[lane] = _Sequence(req, lane)
             self._m_admitted.inc()
             admitted = True
@@ -980,12 +1049,26 @@ class DecodeScheduler:
             rel[i] = r
         _faults.check("serving.decode_step",
                       {"phase": "prefill", "lanes": len(seqs)})
+        w0, c0 = eng._tick_dispatch_wall, eng._compile_wall()
         probs = eng.run(ids, wslots, rel, tables)   # [B, C, V]
         if eng.draft_net is not None:
             # shadow prefill: the draft cache must hold the same prompt
             # context before its first drafting block (same ids, same
             # slots, its own pools)
             eng.run_draft_prefill(ids, wslots, rel, tables)
+        # TTFT attribution: this chunk's dispatch wall (compile split
+        # out) is charged to every sequence it prefilled
+        d_wall = eng._tick_dispatch_wall - w0
+        d_compile = min(eng._compile_wall() - c0, d_wall)
+        for i, seq in enumerate(seqs):
+            seq.prefill_s += d_wall - d_compile
+            seq.compile_s += d_compile
+            if self.tracer is not None and seq.req.span is not None:
+                self.tracer.record(
+                    "prefill_chunk", d_wall, parent=seq.req.span,
+                    attributes={"lane": seq.lane, "bucket": ids.shape[0],
+                                "tokens": int(chunk_len[i]),
+                                "compile_s": round(d_compile, 6)})
         self._m_tokens.inc(sum(chunk_len), phase="prefill")
         for i, seq in enumerate(seqs):
             n = chunk_len[i]
@@ -1018,7 +1101,11 @@ class DecodeScheduler:
             rel[i] = r
         _faults.check("serving.decode_step",
                       {"phase": "decode", "lanes": len(seqs)})
+        w0 = eng._tick_dispatch_wall
         probs = eng.run(ids, wslots, rel, tables)   # [B, 1, V]
+        self._record_block_spans(seqs, "ticked", ids.shape[0],
+                                 [1] * len(seqs),
+                                 eng._tick_dispatch_wall - w0)
         self._m_steps.inc()
         self._m_occupancy.observe(float(len(seqs)))
         self._m_tokens.inc(len(seqs), phase="decode")
@@ -1088,9 +1175,14 @@ class DecodeScheduler:
         _faults.check("serving.decode_step",
                       {"phase": "decode_block", "lanes": len(seqs),
                        "block_len": n})
+        w0 = eng._tick_dispatch_wall
         toks, valid, n_emitted = eng.run_fused(
             a["last"], a["tables"], a["rel"], a["active"], budget,
             a["eos"], a["temps"], a["top_k"], a["top_p"], a["u"])
+        self._record_block_spans(
+            seqs, "fused", a["last"].shape[0],
+            [int(n_emitted[i]) for i in range(len(seqs))],
+            eng._tick_dispatch_wall - w0)
         self._m_steps.inc()
         self._m_occupancy.observe(float(len(seqs)))
         emitted_total = 0
@@ -1138,6 +1230,7 @@ class DecodeScheduler:
         _faults.check("serving.decode_step",
                       {"phase": "spec_block", "lanes": len(seqs),
                        "draft_k": k})
+        w0 = eng._tick_dispatch_wall
         d_toks, d_dists = eng.run_draft(
             a["last"], a["tables"], a["rel"], a["active"], write_budget,
             a["temps"], a["top_k"], a["top_p"], a["u"])
@@ -1145,9 +1238,11 @@ class DecodeScheduler:
             a["last"], a["tables"], a["rel"], a["active"], write_budget,
             d_toks, d_dists, a["temps"], a["top_k"], a["top_p"], u_acc,
             u_fix)
+        spec_wall = eng._tick_dispatch_wall - w0
         self._m_steps.inc()
         self._m_occupancy.observe(float(len(seqs)))
         emitted_total = 0
+        emitted_per_seq: List[int] = []
         for i, seq in enumerate(seqs):
             m = 0
             for j in range(k + 1):
@@ -1157,6 +1252,7 @@ class DecodeScheduler:
                 m += 1
                 if seq.req.done:
                     break
+            emitted_per_seq.append(m)
             if not seq.req.done:
                 # a finished lane was already released by _absorb_token's
                 # retire — advancing it would stamp a phantom position
@@ -1174,11 +1270,30 @@ class DecodeScheduler:
             served = min(int(accepts[i]), m, chanced)
             self._m_draft.inc(served, result="accepted")
             self._m_draft.inc(chanced - served, result="rejected")
+        self._record_block_spans(seqs, "speculative",
+                                 a["last"].shape[0], emitted_per_seq,
+                                 spec_wall)
         self._m_tokens.inc(emitted_total, phase="decode")
         _flight.record("decode_block", kind="speculative",
                        lanes=len(seqs), draft_k=k, tokens=emitted_total,
                        sampled_lanes=n_sampled, active=len(self._active))
         return True
+
+    def _record_block_spans(self, seqs: List[_Sequence], kind: str,
+                            bucket: int, tokens: List[int],
+                            seconds: float) -> None:
+        """Per-request child span for one decode/spec block dispatch —
+        the request timeline's token-production record (lane, bucket,
+        tokens emitted)."""
+        if self.tracer is None:
+            return
+        for i, seq in enumerate(seqs):
+            if seq.req.span is not None:
+                self.tracer.record(
+                    "decode_block", seconds, parent=seq.req.span,
+                    attributes={"kind": kind, "lane": seq.lane,
+                                "bucket": int(bucket),
+                                "tokens": int(tokens[i])})
 
     def _emit_token(self, seq: _Sequence, probs: np.ndarray, *,
                     greedy_tok: Optional[int] = None) -> None:
@@ -1199,7 +1314,30 @@ class DecodeScheduler:
         req = seq.req
         if req.t_first_token is None:
             req.t_first_token = self.clock.monotonic()
-            self._m_ttft.observe(req.t_first_token - req.t_submit)
+            ttft = req.t_first_token - req.t_submit
+            self._m_ttft.observe(ttft)
+            # the decomposition SUMS to the measured TTFT: queue wait
+            # (submit → admission) + this request's own prefill dispatch
+            # wall + the compiles its ticks paid + everything else the
+            # shared ticks did in between (other lanes' dispatches, host
+            # bookkeeping). Components use the same clock as the TTFT
+            # histogram, so the identity holds by construction.
+            queue_wait = max(0.0, (req.t_admit if req.t_admit is not None
+                                   else req.t_submit) - req.t_submit)
+            prefill = min(seq.prefill_s, max(0.0, ttft - queue_wait))
+            compile_s = min(seq.compile_s,
+                            max(0.0, ttft - queue_wait - prefill))
+            req.ttft_breakdown = {
+                "queue_wait": queue_wait, "prefill": prefill,
+                "compile": compile_s,
+                "dispatch": max(0.0, ttft - queue_wait - prefill
+                                - compile_s)}
+            if req.span is not None:
+                req.span.set_attribute("ttft_ms", round(ttft * 1000, 3))
+                req.span.set_attribute(
+                    "ttft_breakdown_ms",
+                    {k: round(v * 1000, 3)
+                     for k, v in req.ttft_breakdown.items()})
         req.tokens.append(tok)
         seq.last_token = tok
         if req.eos_id is not None and tok == req.eos_id:
@@ -1223,6 +1361,16 @@ class DecodeScheduler:
             self._m_tpot.observe(
                 (req.t_done - req.t_first_token)
                 / (len(req.tokens) - 1))
+        if req.tokens:
+            self._m_goodput.inc(
+                len(req.tokens),
+                slo="met" if reason in ("eos", "max_tokens")
+                else "missed")
+        if req.span is not None:
+            req.span.set_attribute("finish_reason", reason)
+            req.span.set_attribute("tokens", len(req.tokens))
+            self._end_request_spans(
+                req, None if reason in ("eos", "max_tokens") else reason)
         req.event.set()
 
     # -- loop / lifecycle ---------------------------------------------

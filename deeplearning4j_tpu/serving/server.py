@@ -16,6 +16,13 @@ Endpoints:
   GET  /debug/flightrecorder
                   the process flight recorder's current event ring as
                   JSON (util/flightrecorder.py — the black box)
+  GET  /debug/timeline
+                  per-request decode timelines + all traces from this
+                  server's tracer (util/timeline.py), nested by
+                  parentage; ?trace_id= filters to one trace. Incoming
+                  ``traceparent`` headers parent the request spans
+                  (Dapper-style propagation) and every response carries
+                  a ``traceparent`` back
   POST /profile?seconds=N
                   capture a jax.profiler device trace (XPlane) for N
                   seconds (default 1, max 300) into a fresh run
@@ -80,6 +87,7 @@ import numpy as np
 
 from ..util import faults as _faults
 from ..util import metrics as _metrics
+from ..util import tracing as _tracing
 from ..util.resilience import (SYSTEM_CLOCK, STATE_VALUES, CircuitBreaker,
                                Clock, Deadline)
 
@@ -185,13 +193,22 @@ class InferenceServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
-                for k, v in (headers or {}).items():
+                headers = dict(headers or {})
+                # header in → header out: a caller's trace context is
+                # echoed (or replaced by the request's own span) so the
+                # client can find its spans in /debug/timeline
+                tp = headers.pop("traceparent",
+                                 self.headers.get("traceparent"))
+                if tp:
+                    self.send_header("traceparent", tp)
+                for k, v in headers.items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = urlparse(self.path).path
+                url = urlparse(self.path)
+                path = url.path
                 if path == "/healthz":
                     self._json(outer._health())
                 elif path == "/metrics":
@@ -200,6 +217,27 @@ class InferenceServer:
                 elif path == "/debug/flightrecorder":
                     from ..util import flightrecorder as _flight
                     self._json({"events": _flight.jsonable_events()})
+                elif path == "/debug/timeline":
+                    from ..util import timeline as _timeline
+                    q = parse_qs(url.query)
+                    # a prebuilt DecodeScheduler may carry its own
+                    # tracer — that is where the request spans live
+                    tracer = outer.tracer
+                    if tracer is None and outer.decode is not None:
+                        tracer = outer.decode.tracer
+                    if tracer is None:
+                        tracer = _tracing.TRACER
+                    tid = q.get("trace_id", [None])[0]
+                    payload = {
+                        "requests": _timeline.request_timelines(
+                            tracer, trace_id=tid),
+                        "traces": _timeline.trace_summaries(
+                            tracer, trace_id=tid)}
+                    # repr-stringify odd attribute values, like the
+                    # flight-recorder endpoint — debug inspection must
+                    # not 500 on one unserializable attribute
+                    self._json(json.loads(
+                        json.dumps(payload, default=repr)))
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -218,23 +256,33 @@ class InferenceServer:
                 except Exception as e:
                     self._json({"error": f"bad request: {e}"}, 400)
                     return
+                trace_ctx = self.headers.get("traceparent")
                 if url.path == "/predict":
                     try:
                         x = np.asarray(payload["inputs"], dtype=np.float32)
                     except Exception as e:
                         self._json({"error": f"bad inputs: {e}"}, 400)
                         return
-                    out, err, code, retry_after = outer._predict(x)
+                    out, err, code, retry_after, tp = outer._predict(
+                        x, trace_ctx=trace_ctx)
+                    headers = {}
+                    if retry_after is not None:
+                        headers["Retry-After"] = f"{retry_after:.0f}"
+                    if tp is not None:
+                        headers["traceparent"] = tp
                     if err is not None:
-                        headers = ({"Retry-After": f"{retry_after:.0f}"}
-                                   if retry_after is not None else None)
                         self._json({"error": err}, code, headers)
                     else:
-                        self._json({"outputs": out.tolist()})
+                        self._json({"outputs": out.tolist()}, 200,
+                                   headers)
                 elif url.path == "/generate":
-                    body, code, retry_after = outer._generate(payload)
-                    headers = ({"Retry-After": f"{retry_after:.0f}"}
-                               if retry_after is not None else None)
+                    body, code, retry_after, tp = outer._generate(
+                        payload, trace_ctx=trace_ctx)
+                    headers = {}
+                    if retry_after is not None:
+                        headers["Retry-After"] = f"{retry_after:.0f}"
+                    if tp is not None:
+                        headers["traceparent"] = tp
                     self._json(body, code, headers)
                 elif url.path == "/model":
                     try:
@@ -346,20 +394,22 @@ class InferenceServer:
                            "queued": self.decode.queue_depth()}
         return h
 
-    def _generate(self, payload: dict
-                  ) -> Tuple[dict, int, Optional[float]]:
-        """POST /generate → (body, http_code, retry_after_s). Blocks the
-        handler thread until the scheduler finishes the request (the
-        continuous-batching loop runs it concurrently with every other
-        in-flight sequence)."""
+    def _generate(self, payload: dict, trace_ctx: Optional[str] = None
+                  ) -> Tuple[dict, int, Optional[float], Optional[str]]:
+        """POST /generate → (body, http_code, retry_after_s,
+        traceparent_out). Blocks the handler thread until the scheduler
+        finishes the request (the continuous-batching loop runs it
+        concurrently with every other in-flight sequence). The caller's
+        ``traceparent`` parents the request's decode spans; the response
+        header carries the request root span's context back."""
         from .decode import SchedulerDraining, SchedulerSaturated
         if self.decode is None:
             return ({"error": "generative decode not enabled on this "
-                              "server (pass decode=)"}, 400, None)
+                              "server (pass decode=)"}, 400, None, None)
         try:
             prompt = payload["prompt_ids"]
         except KeyError:
-            return {"error": "missing prompt_ids"}, 400, None
+            return {"error": "missing prompt_ids"}, 400, None, None
         try:
             # coerce up front: a numeric STRING would pass Deadline's
             # float() inside submit and then blow up in the wait
@@ -367,7 +417,7 @@ class InferenceServer:
             timeout_s = (None if payload.get("timeout_s") is None
                          else float(payload["timeout_s"]))
         except (TypeError, ValueError) as e:
-            return {"error": f"bad timeout_s: {e}"}, 400, None
+            return {"error": f"bad timeout_s: {e}"}, 400, None, None
         try:
             req = self.decode.submit(
                 prompt, payload.get("max_new_tokens"),
@@ -376,50 +426,60 @@ class InferenceServer:
                 timeout_s=timeout_s,
                 seed=payload.get("seed"),
                 top_k=int(payload.get("top_k", 0)),
-                top_p=float(payload.get("top_p", 1.0)))
+                top_p=float(payload.get("top_p", 1.0)),
+                trace_ctx=trace_ctx)
         except SchedulerDraining:
-            return {"error": "server is draining"}, 503, 1.0
+            return {"error": "server is draining"}, 503, 1.0, None
         except SchedulerSaturated as e:
             return ({"error": "server overloaded (decode queue full)"},
-                    503, e.retry_after)
+                    503, e.retry_after, None)
         except (ValueError, TypeError) as e:
-            return {"error": f"bad request: {e}"}, 400, None
+            return {"error": f"bad request: {e}"}, 400, None, None
+        tp = (_tracing.inject(req.span) if req.span is not None else None)
         budget = (timeout_s if timeout_s is not None
                   else self.decode.request_timeout_s)
         req.wait(timeout=budget + 5.0)
-        if req.finish_reason is None:          # scheduler wedged — honest 504
-            return {"error": "generation timeout"}, 504, None
+        if req.finish_reason is None:      # scheduler wedged — honest 504
+            return {"error": "generation timeout"}, 504, None, tp
         if req.finish_reason == "error":
-            return ({"error": req.error or "decode failed"}, 500, None)
+            return ({"error": req.error or "decode failed"}, 500, None,
+                    tp)
         if req.finish_reason == "shutdown":
-            return {"error": "server shutting down"}, 503, None
+            return {"error": "server shutting down"}, 503, None, tp
         if req.finish_reason == "deadline" and not req.tokens:
-            return {"error": "request deadline exceeded"}, 504, None
+            return {"error": "request deadline exceeded"}, 504, None, tp
         body = {"tokens": [int(t) for t in req.tokens],
                 "finish_reason": req.finish_reason,
                 "n_generated": len(req.tokens)}
         if req.t_first_token is not None:
             body["ttft_ms"] = round(
                 1000.0 * (req.t_first_token - req.t_submit), 3)
-        return body, 200, None
+        if req.span is not None:
+            body["trace_id"] = req.span.trace_id
+        return body, 200, None, tp
 
-    def _predict(self, x: np.ndarray
+    def _predict(self, x: np.ndarray, trace_ctx: Optional[str] = None
                  ) -> Tuple[Optional[np.ndarray], Optional[str],
-                            int, Optional[float]]:
-        """Returns (outputs, error, http_code, retry_after_s)."""
+                            int, Optional[float], Optional[str]]:
+        """Returns (outputs, error, http_code, retry_after_s,
+        traceparent_out). ``trace_ctx`` (an incoming traceparent header)
+        parents the predict span on the caller's trace."""
         if self._draining or self._stop.is_set():
             self._m_shed.inc(reason="draining")
-            return None, "server is draining", 503, 1.0
+            return None, "server is draining", 503, 1.0, None
         if not self.breaker.allow():
             self._m_shed.inc(reason="breaker_open")
             retry = max(1.0, self.breaker.retry_after())
             return (None, "model circuit open (failing upstream)", 503,
-                    retry)
+                    retry, None)
         p = _Pending(x, Deadline(self.request_timeout_s, self.clock))
+        tp = None
         if self.tracer is not None:
             p.span = self.tracer.start(
-                "predict", attributes={"examples": int(x.shape[0])})
+                "predict", parent=_tracing.extract(trace_ctx),
+                attributes={"examples": int(x.shape[0])})
             p.queue_span = self.tracer.start("queue", parent=p.span)
+            tp = _tracing.inject(p.span)
         with self._pending_lock:
             self._pending += 1
         try:
@@ -432,13 +492,13 @@ class InferenceServer:
             self._m_shed.inc(reason="queue_full")
             self._end_spans(p, "shed")
             return (None, "server overloaded (queue full)", 503,
-                    max(1.0, self.batch_timeout_s))
+                    max(1.0, self.batch_timeout_s), tp)
         p.event.wait(timeout=self.request_timeout_s + 1.0)
         if p.error is not None:
-            return None, p.error, p.code, None
+            return None, p.error, p.code, None, tp
         if p.result is None:
-            return None, "inference timeout", 504, None
-        return p.result, None, 200, None
+            return None, "inference timeout", 504, None, tp
+        return p.result, None, 200, None, tp
 
     @staticmethod
     def _end_spans(p: _Pending, status: Optional[str] = None) -> None:

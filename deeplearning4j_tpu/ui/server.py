@@ -23,6 +23,11 @@ Endpoints:
                             is passed to UIServer)
   GET  /debug/flightrecorder the process flight recorder's event ring
                             (util/flightrecorder.py)
+  GET  /debug/timeline      the process-default tracer's traces, nested
+                            by parentage (util/timeline.py); optional
+                            ?trace_id= filter. Requests carrying a
+                            ``traceparent`` header join the caller's
+                            trace (one ui.request span, header echoed)
   POST /profile?seconds=N   capture a jax.profiler device trace for N
                             seconds (409 while one is in progress) —
                             profile the TRAINING process the dashboard
@@ -43,6 +48,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..storage.stats_storage import StatsStorage
 from ..util import metrics as _metrics
+from ..util import tracing as _tracing
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu training UI</title>
@@ -254,10 +260,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # header in → header out: the caller's trace context (or the
+        # ui.request span opened under it) rides the response back
+        tp = getattr(self, "_traceparent_out", None) \
+            or self.headers.get("traceparent")
+        if tp:
+            self.send_header("traceparent", tp)
         self.end_headers()
         self.wfile.write(body)
 
+    def _traced(self, method):
+        """Dashboard requests carrying a ``traceparent`` header join the
+        caller's trace: one ``ui.request`` span in the process-default
+        tracer, its context echoed in the response header."""
+        ctx = _tracing.extract(self.headers.get("traceparent"))
+        if ctx is None:
+            self._traceparent_out = None
+            return method()
+        with _tracing.TRACER.span(
+                "ui.request", parent=ctx,
+                attributes={"path": urlparse(self.path).path}) as span:
+            self._traceparent_out = _tracing.inject(span)
+            return method()
+
     def do_GET(self):
+        return self._traced(self._handle_get)
+
+    def _handle_get(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
         st = self.storage
@@ -276,6 +305,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/debug/flightrecorder":
             from ..util import flightrecorder as _flight
             self._json({"events": _flight.jsonable_events()})
+        elif url.path == "/debug/timeline":
+            from ..util import timeline as _timeline
+            tid = q.get("trace_id", [None])[0]
+            payload = {"traces": _timeline.trace_summaries(
+                _tracing.TRACER, trace_id=tid)}
+            self._json(json.loads(json.dumps(payload, default=repr)))
         elif url.path == "/api/sessions":
             self._json(st.list_session_ids())
         elif url.path == "/api/overview":
@@ -394,6 +429,9 @@ class _Handler(BaseHTTPRequestHandler):
         return {"nodes": [], "edges": []}
 
     def do_POST(self):
+        return self._traced(self._handle_post)
+
+    def _handle_post(self):
         url = urlparse(self.path)
         if url.path == "/profile":
             # same contract as the inference server's /profile (one

@@ -42,6 +42,28 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 DEFAULT_CAPACITY = 512
 
+# Context providers: called on every record() to stamp ambient context
+# (e.g. the active trace id, registered by util.tracing) into the event.
+# Provider fields never override the caller's explicit fields, and a
+# failing provider is ignored — recording must never raise.
+_context_providers: List = []
+
+
+def add_context_provider(fn) -> None:
+    """Register a zero-arg callable returning a dict of extra fields for
+    every recorded event (same shape as faults.add_context_provider)."""
+    _context_providers.append(fn)
+
+
+def _ambient_context() -> Dict:
+    out: Dict = {}
+    for fn in _context_providers:
+        try:
+            out.update(fn() or {})
+        except Exception:
+            pass
+    return out
+
 
 def _capacity_default() -> int:
     n = int(os.environ.get("DL4JTPU_FLIGHT_EVENTS", str(DEFAULT_CAPACITY)))
@@ -81,7 +103,8 @@ class FlightRecorder:
     # -- recording -----------------------------------------------------
 
     def record(self, kind: str, /, **fields) -> dict:
-        event = {"seq": 0, "t": time.time(), "kind": str(kind), **fields}
+        event = {"seq": 0, "t": time.time(), "kind": str(kind),
+                 **_ambient_context(), **fields}
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq

@@ -259,6 +259,12 @@ class Histogram(_Metric):
             s = self._series.get(key)
             return 0.0 if s is None else s[2]
 
+    def total_sum(self) -> float:
+        """Sum of observed values across EVERY labelset (e.g. compile
+        wall across all jitted functions)."""
+        with self._lock:
+            return sum(s[2] for s in self._series.values())
+
     def _samples(self) -> List[str]:
         with self._lock:
             series = {k: [list(s[0]), s[1], s[2]]

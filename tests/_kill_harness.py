@@ -123,10 +123,15 @@ def elastic_fleet_configs(n: int, store_dir: str, base_dir: str, *,
                           max_staleness: int = 1, lease_s: float = 1.0,
                           evict_after_s: float = None, seed: int = 7,
                           kill_plans: dict = None,
-                          watchdog_s: float = None) -> list:
+                          watchdog_s: float = None,
+                          traceparent: str = None) -> list:
     """One config dict per rank. ``kill_plans`` maps rank ->
     {"kill_mode": ..., "kill_at_iteration": ...} (iteration counts LOCAL
-    steps on that rank; the "training.step" seam fires before each)."""
+    steps on that rank; the "training.step" seam fires before each).
+    ``traceparent`` (a tracing.inject() string) becomes every child's
+    DL4JTPU_TRACEPARENT: all hosts' round spans join ONE fleet trace,
+    and each child exports trace_<host>.jsonl into its checkpoint dir
+    for the timeline collector."""
     fleet = [f"h{i}" for i in range(n)]
     out = []
     for i, host in enumerate(fleet):
@@ -138,6 +143,7 @@ def elastic_fleet_configs(n: int, store_dir: str, base_dir: str, *,
             "max_staleness": max_staleness, "lease_s": lease_s,
             "evict_after_s": evict_after_s, "seed": seed,
             "watchdog_s": watchdog_s,
+            "traceparent": traceparent,
         }
         cfg.update((kill_plans or {}).get(i, {}))
         out.append(cfg)
@@ -288,6 +294,8 @@ def _elastic_child_main(config: dict) -> None:
     directory = config["checkpoint_dir"]
     os.makedirs(directory, exist_ok=True)
     os.environ["DL4JTPU_FLIGHT_DIR"] = directory
+    if config.get("traceparent"):
+        os.environ["DL4JTPU_TRACEPARENT"] = config["traceparent"]
 
     host = config["host"]
     fleet = tuple(config["fleet"])
@@ -314,6 +322,18 @@ def _elastic_child_main(config: dict) -> None:
             trainer.fit(batch_fn, rounds=config["rounds"])
     except Exception as e:       # report protocol errors via result.json
         error = f"{type(e).__name__}: {e}"
+
+    # per-host span export for the timeline collector (best-effort: a
+    # hard-killed child leaves only its store-side trace records)
+    trace_id = None
+    try:
+        trainer.tracer.export_jsonl(
+            os.path.join(directory, f"trace_{host}.jsonl"))
+        fits = trainer.tracer.find("elastic.fit")
+        if fits:
+            trace_id = fits[-1].trace_id
+    except Exception:
+        pass
 
     from deeplearning4j_tpu.util import flightrecorder as _flight
     reg = _metrics.REGISTRY
@@ -346,8 +366,17 @@ def _elastic_child_main(config: dict) -> None:
                     "waiting_on": e.get("waiting_on")}
                    for e in _flight.events("elastic_stall")],
         "evictions": [{"host": e.get("host"),
-                       "effective_round": e.get("effective_round")}
+                       "effective_round": e.get("effective_round"),
+                       "trace_id": e.get("trace_id")}
                       for e in _flight.events("elastic_evict")],
+        # lease-level evict/rejoin observations with the trace they were
+        # recorded under (the observer's active round span)
+        "membership_events": [{"event": e.get("event"),
+                               "host": e.get("host"),
+                               "trace_id": e.get("trace_id")}
+                              for e in _flight.events(
+                                  "elastic_membership")],
+        "trace_id": trace_id,
         "error": error,
     }
     with open(os.path.join(directory, f"result_{host}.json"), "w") as f:

@@ -71,6 +71,17 @@ def main() -> None:
     assert jax.device_count() == 8, jax.device_count()
     assert jax.local_device_count() == 4
 
+    # cross-process trace propagation: when the spawning test set
+    # DL4JTPU_TRACEPARENT, this worker's training joins that trace and
+    # reports its span identity in RESULT for the parent to assert on
+    from deeplearning4j_tpu.util import tracing as _tracing
+    span = None
+    ctx = _tracing.env_context()
+    if ctx is not None:
+        span = _tracing.TRACER.start(
+            "worker.fit", parent=ctx,
+            attributes={"rank": rank, "mode": mode})
+
     net = build_worker_net()
     losses = []
     if mode == "sync":
@@ -107,8 +118,12 @@ def main() -> None:
     checksum = float(sum(
         jnp.abs(l).sum()
         for l in jax.tree_util.tree_leaves(net.params)))
-    print("RESULT", rank, json.dumps({"losses": losses,
-                                      "checksum": checksum}), flush=True)
+    result = {"losses": losses, "checksum": checksum}
+    if span is not None:
+        span.end()
+        result["trace_id"] = span.trace_id
+        result["parent_span_id"] = span.parent_id
+    print("RESULT", rank, json.dumps(result), flush=True)
     dist.shutdown()
 
 

@@ -182,10 +182,13 @@ class TestShardedEvaluation:
         assert s1 == pytest.approx(s2, rel=1e-5)
 
 
-def _spawn_two_process(n_steps, mode="sync", timeout=300, attempts=2):
+def _spawn_two_process(n_steps, mode="sync", timeout=300, attempts=2,
+                       traceparent=None):
     """Run the two-process worker pair; one bounded retry with a fresh
     coordinator port (the bind-then-release port can be stolen between
-    probing it and jax.distributed binding it — the known load flake)."""
+    probing it and jax.distributed binding it — the known load flake).
+    ``traceparent`` rides DL4JTPU_TRACEPARENT into both workers: their
+    training spans join the caller's trace (asserted via RESULT)."""
     import socket
     import subprocess
     import sys as _sys
@@ -194,6 +197,8 @@ def _spawn_two_process(n_steps, mode="sync", timeout=300, attempts=2):
     worker = str(Path(__file__).parent / "_two_process_worker.py")
     env = {k: v for k, v in __import__("os").environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    if traceparent is not None:
+        env["DL4JTPU_TRACEPARENT"] = traceparent
     last_err = ""
     for attempt in range(attempts):
         with socket.socket() as s:
@@ -247,16 +252,28 @@ class TestTwoProcessDistributed:
     N_STEPS = 4
 
     def _spawn(self):
-        return _spawn_two_process(self.N_STEPS, mode="sync")
+        from deeplearning4j_tpu.util import tracing
+        root = tracing.TRACER.start("two_process_fleet")
+        try:
+            return root, _spawn_two_process(
+                self.N_STEPS, mode="sync",
+                traceparent=tracing.inject(root))
+        finally:
+            root.end()
 
     def test_two_process_sync_training_matches_single_process(self, rng):
-        results = self._spawn()
+        root, results = self._spawn()
         # both ranks observed the same global losses and ended with the
         # same parameters (replicated SPMD state)
         assert results[0]["losses"] == pytest.approx(results[1]["losses"],
                                                      rel=1e-6)
         assert results[0]["checksum"] == pytest.approx(
             results[1]["checksum"], rel=1e-6)
+        # the trace context crossed the process boundary: each worker's
+        # fit span joined the spawning test's trace, parented on it
+        for rank in (0, 1):
+            assert results[rank]["trace_id"] == root.trace_id
+            assert results[rank]["parent_span_id"] == root.span_id
 
         # single-process oracle on the same global batches (the Spark
         # correctness-oracle pattern, SURVEY §4)

@@ -344,6 +344,63 @@ class TestInProcessFleet:
         assert set(killed.values()) == set(clean.values()), \
             "kill/restore run diverged from the clean run"
 
+    def test_fleet_timeline_merges_and_attributes(self, tmp_path,
+                                                  monkeypatch):
+        """ISSUE 13 tentpole (in-process leg): round spans exported as
+        store records + per-host JSONL merge into ONE fleet timeline
+        that names a critical-path host and phase per round, all under
+        the fleet trace id the scheduler handed the hosts."""
+        from deeplearning4j_tpu.util import timeline, tracing
+        fleet_trace, sched_span = "ee" * 16, "ff" * 8
+        monkeypatch.setenv(tracing.TRACEPARENT_ENV,
+                           f"00-{fleet_trace}-{sched_span}-01")
+        store = FileCoordinationStore(str(tmp_path / "store"))
+        fleet = _Fleet()
+        for i, h in enumerate(("h0", "h1")):
+            fleet.start(ElasticTrainer(
+                harness.build_net(SEED), store, _cfg(h),
+                registry=MetricsRegistry()), _batch_fn(i))
+        fleet.join()
+        for h, tr in fleet.trainers.items():
+            tr.tracer.export_jsonl(str(tmp_path / f"trace_{h}.jsonl"))
+
+        tl = timeline.build_fleet_timeline(
+            store=str(tmp_path / "store"),
+            jsonl_paths=[str(tmp_path / "trace_*.jsonl")])
+        assert [rd["round"] for rd in tl["rounds"]] == list(range(ROUNDS))
+        for rd in tl["rounds"]:
+            assert rd["critical_host"] in ("h0", "h1")
+            assert rd["critical_phase"]
+            assert sorted(rd["members"]) == ["h0", "h1"]
+            for h in ("h0", "h1"):
+                row = rd["hosts"][h]
+                assert row["phases_ms"].get("local_steps", 0) > 0
+                assert row["duration_ms"] > 0
+        # one fleet trace: every host's spans joined the scheduler's
+        # context, and round spans parent to each host's fit root
+        assert tl["trace_ids"] == [fleet_trace]
+        for h, tr in fleet.trainers.items():
+            spans = tr.tracer.finished
+            fit = next(s for s in spans if s.name == "elastic.fit")
+            assert fit.trace_id == fleet_trace
+            assert fit.parent_id == sched_span
+            assert fit.host == h                    # logical host id
+            for s in spans:
+                if s.name == "elastic.round":
+                    assert s.parent_id == fit.span_id
+        # store-only merge (the post-mortem case: no JSONL survived)
+        tl_store = timeline.build_fleet_timeline(
+            store=str(tmp_path / "store"))
+        assert [(rd["critical_host"], rd["critical_phase"])
+                for rd in tl_store["rounds"]] == \
+            [(rd["critical_host"], rd["critical_phase"])
+             for rd in tl["rounds"]]
+        # the CLI is the same collector
+        from deeplearning4j_tpu.util.timeline import main as tl_main
+        assert tl_main(["--store", str(tmp_path / "store")]) == 0
+        assert tl_main(["--store", str(tmp_path / "store"),
+                        "--json"]) == 0
+
 
 @pytest.mark.chaos
 class TestFleetChaosSubprocess:
@@ -365,13 +422,20 @@ class TestFleetChaosSubprocess:
         # of round 2) and rescheduled 3s later — longer than the lease,
         # so the survivor OBSERVES the dropout; survivors keep stepping
         # (staleness window), the restart restores its snapshot,
-        # replays, and backfills the rounds the fleet is blocked on
+        # replays, and backfills the rounds the fleet is blocked on.
+        # The fleet runs under ONE trace context (the parent-as-
+        # scheduler's), so both hosts' round spans merge into one
+        # timeline below.
+        from deeplearning4j_tpu.util import timeline, tracing
+        root = tracing.TRACER.start("chaos_fleet")
+        root.end()
         cfgs = harness.elastic_fleet_configs(
             2, str(tmp_path / "store2"), str(tmp_path / "kill"),
             rounds=4, steps_per_round=2, max_staleness=1, lease_s=1.5,
             evict_after_s=120.0,        # rejoin must beat hard eviction
             kill_plans={1: {"kill_mode": "sigterm",
-                            "kill_at_iteration": 4}})
+                            "kill_at_iteration": 4}},
+            traceparent=tracing.inject(root))
         restart = {k: v for k, v in cfgs[1].items()
                    if k not in ("kill_mode", "kill_at_iteration")}
         out = harness.run_fleet(cfgs, timeout=200,
@@ -389,6 +453,45 @@ class TestFleetChaosSubprocess:
         tr = out["h0"]["result"]["transitions"]
         assert tr.get("evict:h1", 0) >= 1, tr
         assert tr.get("rejoin:h1", 0) >= 1, tr
+
+        # -- merged fleet timeline (ISSUE 13 acceptance) ---------------
+        # store trace records + whatever JSONL the (restarted) children
+        # exported merge into one timeline that names a critical-path
+        # host and phase for EVERY round despite the kill+rejoin
+        tl = timeline.build_fleet_timeline(
+            store=str(tmp_path / "store2"),
+            jsonl_paths=[str(tmp_path / "kill" / "*" / "trace_*.jsonl")])
+        assert [rd["round"] for rd in tl["rounds"]] == [0, 1, 2, 3]
+        for rd in tl["rounds"]:
+            assert rd["critical_host"] in ("h0", "h1"), rd
+            assert rd["critical_phase"], rd
+            assert sorted(rd["members"]) == ["h0", "h1"]
+            # the killed host's rounds are all present: 0-1 from its
+            # first incarnation's store records, 2-3 from the rejoin
+            assert rd["hosts"]["h1"]["phases_ms"].get(
+                "local_steps", 0) > 0, rd
+        assert tl["trace_ids"] == [root.trace_id]
+        exp_inc = {0: 1, 1: 1, 2: 2, 3: 2}
+        for rd in tl["rounds"]:
+            assert rd["hosts"]["h1"]["incarnation"] == \
+                exp_inc[rd["round"]], rd
+        # the rejoined incarnation's round spans parent to ITS fit root
+        h1_spans = timeline.load_jsonl(
+            str(tmp_path / "kill" / "h1" / "trace_h1.jsonl"))
+        assert all(s["trace_id"] == root.trace_id for s in h1_spans)
+        fit2 = [s for s in h1_spans if s["name"] == "elastic.fit"][-1]
+        assert fit2["attributes"]["incarnation"] == 2
+        h1_rounds = [s for s in h1_spans if s["name"] == "elastic.round"]
+        assert {(s["attributes"]["round"]) for s in h1_rounds} == {2, 3}
+        assert all(s["parent_id"] == fit2["span_id"] for s in h1_rounds)
+        assert out["h1"]["result"]["trace_id"] == root.trace_id
+        # the survivor's evict/rejoin observations were recorded under
+        # the fleet trace (its active round span at observation time)
+        ev = out["h0"]["result"]["membership_events"]
+        assert any(e["event"] == "evict" and e["host"] == "h1"
+                   and e["trace_id"] == root.trace_id for e in ev), ev
+        assert any(e["event"] == "rejoin" and e["host"] == "h1"
+                   and e["trace_id"] == root.trace_id for e in ev), ev
 
     def test_hang_and_hard_kill_evicted_within_deadline(self, tmp_path):
         """h1 wedges (hang) mid-round and h2 hard-exits: the survivor
@@ -413,6 +516,12 @@ class TestFleetChaosSubprocess:
         assert res["transitions"].get("hard_evict:h2", 0) >= 1
         evicted = {e["host"] for e in res["evictions"]}
         assert evicted == {"h1", "h2"}
+        # fault-correlation: each hard-evict event was stamped with the
+        # trace of the survivor's round/fit span that performed it, so
+        # the dump cross-references the exact round it interrupted
+        assert res["trace_id"] is not None
+        for e in res["evictions"]:
+            assert e["trace_id"] == res["trace_id"], e
         # stall attribution names the wedged hosts
         waited_on = {h for s in res["stalls"] for h in s["waiting_on"]}
         assert waited_on <= {"h1", "h2"} and waited_on

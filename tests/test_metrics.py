@@ -318,6 +318,99 @@ class TestTracing:
         assert ctx["span"]["span_id"] == hot.span_id
 
 
+class TestTraceContext:
+    """Cross-scope propagation (ISSUE 13): serializable contexts, span
+    provenance, the bounded ring's drop accounting, and the flight-
+    recorder trace stamp."""
+
+    def test_inject_extract_roundtrip(self):
+        from deeplearning4j_tpu.util import tracing
+        tr = Tracer()
+        with tr.span("root") as root:
+            header = tracing.inject(root)
+        assert header == f"00-{root.trace_id}-{root.span_id}-01"
+        ctx = tracing.extract(header)
+        assert ctx.trace_id == root.trace_id
+        assert ctx.span_id == root.span_id
+        # an extracted context is a valid remote parent
+        child = tr.start("remote_child", parent=ctx)
+        child.end()
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+
+    def test_extract_rejects_malformed(self):
+        from deeplearning4j_tpu.util import tracing
+        for bad in (None, "", "garbage", "00-short-short-01",
+                    "00-" + "z" * 32 + "-" + "a" * 16 + "-01"):
+            assert tracing.extract(bad) is None
+
+    def test_spans_carry_host_and_pid(self):
+        import os as _os
+        tr = Tracer(host="logical-h3")
+        with tr.span("x") as s:
+            pass
+        d = s.to_dict()
+        assert d["host"] == "logical-h3"
+        assert d["pid"] == _os.getpid()
+        # default host is the machine hostname
+        tr2 = Tracer()
+        with tr2.span("y") as s2:
+            pass
+        assert s2.host == __import__("socket").gethostname()
+
+    def test_env_context(self, monkeypatch):
+        from deeplearning4j_tpu.util import tracing
+        monkeypatch.delenv(tracing.TRACEPARENT_ENV, raising=False)
+        assert tracing.env_context() is None
+        monkeypatch.setenv(tracing.TRACEPARENT_ENV,
+                           "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+        ctx = tracing.env_context()
+        assert ctx.trace_id == "ab" * 16 and ctx.span_id == "cd" * 8
+
+    def test_ring_overflow_counts_drops(self):
+        """Satellite: the silent oldest-span drop is now counted and
+        warned about once."""
+        reg = MetricsRegistry()
+        tr = Tracer(max_spans=4, registry=reg)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        ctr = reg.get("tracer_spans_dropped_total")
+        assert ctr is not None and ctr.value() == 6
+        assert [s.name for s in tr.finished] == \
+            ["s6", "s7", "s8", "s9"]
+
+    def test_max_spans_env_configurable(self, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_TRACE_MAX_SPANS", "7")
+        tr = Tracer(registry=MetricsRegistry())
+        assert tr.max_spans == 7
+        monkeypatch.setenv("DL4JTPU_TRACE_MAX_SPANS", "0")
+        with pytest.raises(ValueError):
+            Tracer(registry=MetricsRegistry())
+
+    def test_flight_events_stamp_active_trace(self):
+        from deeplearning4j_tpu.util import flightrecorder as flight
+        tr = Tracer()
+        e_outside = flight.record("trace_stamp_probe", n=1)
+        assert "trace_id" not in e_outside
+        with tr.span("round") as s:
+            e = flight.record("trace_stamp_probe", n=2)
+        assert e["trace_id"] == s.trace_id
+        assert e["span_id"] == s.span_id
+        # explicit fields always win over ambient context
+        with tr.span("round2"):
+            e2 = flight.record("trace_stamp_probe", trace_id="explicit")
+        assert e2["trace_id"] == "explicit"
+
+    def test_record_explicit_duration(self):
+        tr = Tracer()
+        with tr.span("parent") as p:
+            s = tr.record("phase", 0.25, attributes={"round": 3})
+        assert s.parent_id == p.span_id
+        assert abs(s.duration_ms - 250.0) < 1e-6
+        assert s.attributes == {"round": 3}
+
+
 # ---------------------------------------------------------------------------
 # resilience counters
 # ---------------------------------------------------------------------------
@@ -798,26 +891,41 @@ class TestServingMetrics:
 
     def test_tracer_parents_predict_queue_batch_model(self, rng):
         """Acceptance: Tracer JSONL export shows parented spans for a
-        predict request (queue → batch → model)."""
+        predict request (queue → batch → model), the incoming
+        ``traceparent`` header parents the whole tree on the caller's
+        trace, and the response carries the predict span's context."""
         from deeplearning4j_tpu.serving import InferenceServer
         net = _tiny_net()
         tracer = Tracer()
         server = InferenceServer(net, port=0, max_batch=4, tracer=tracer)
         base = f"http://127.0.0.1:{server.port}"
         x = rng.normal(size=(2, 5)).astype(np.float32)
+        client_trace, client_span = "ab" * 16, "cd" * 8
+        header_in = f"00-{client_trace}-{client_span}-01"
         try:
-            code, _ = _post(base, "/predict", {"inputs": x.tolist()})
-            assert code == 200
+            req = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"inputs": x.tolist()}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "traceparent": header_in})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+                header_out = r.headers.get("traceparent")
         finally:
             server.stop()
         spans = {s.name: s for s in tracer.finished}
         assert {"predict", "queue", "batch", "model"} <= set(spans)
-        assert spans["predict"].parent_id is None
+        # the whole tree joined the CLIENT's trace (Dapper propagation)
+        assert spans["predict"].parent_id == client_span
         assert spans["queue"].parent_id == spans["predict"].span_id
         assert spans["batch"].parent_id == spans["predict"].span_id
         assert spans["model"].parent_id == spans["batch"].span_id
         tids = {s.trace_id for s in spans.values()}
-        assert len(tids) == 1
+        assert tids == {client_trace}
+        # header out names the server-side root of the request
+        assert header_out == \
+            f"00-{client_trace}-{spans['predict'].span_id}-01"
         assert spans["predict"].attributes["code"] == 200
         # the JSONL export carries the same structure
         lines = [json.loads(l) for l in tracer.to_jsonl().splitlines()]
@@ -845,3 +953,104 @@ class TestServingMetrics:
         assert ctx["span"]["name"] == "model"
         model_spans = [s for s in tracer.finished if s.name == "model"]
         assert ctx["span"]["span_id"] in {s.span_id for s in model_spans}
+
+
+# ---------------------------------------------------------------------------
+# metrics-convention lint (ISSUE 13 satellite): the exposition contract
+# ---------------------------------------------------------------------------
+
+_NAME_LINT = re.compile(r"^[a-z][a-z0-9_]*$")
+_LABEL_LINT = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# histograms/gauges that count THINGS rather than measure a unit —
+# additions need a reason (a unitless distribution like a batch size),
+# not a forgotten _seconds suffix
+_UNITLESS_HISTOGRAMS = {
+    "serving_batch_size",           # examples per coalesced model call
+    "decode_batch_occupancy",       # lanes active per decode step
+}
+_UNIT_SUFFIXES = ("_seconds", "_bytes")
+# reserved by the Prometheus exposition itself
+_RESERVED_LABELS = {"le", "quantile"}
+_MAX_SERIES_PER_METRIC = 128
+
+
+def _lint_registry(reg, where: str):
+    problems = []
+    for name in reg.names():
+        m = reg.get(name)
+        if not _NAME_LINT.match(name):
+            problems.append(f"{where}: {name}: not snake_case")
+        if m.kind == "counter" and not name.endswith("_total"):
+            problems.append(f"{where}: {name}: counter without _total")
+        if m.kind != "counter" and name.endswith("_total"):
+            problems.append(f"{where}: {name}: _total reserved for "
+                            "counters")
+        if m.kind == "histogram" and name not in _UNITLESS_HISTOGRAMS \
+                and not name.endswith(_UNIT_SUFFIXES):
+            problems.append(
+                f"{where}: {name}: histogram without a unit suffix "
+                f"({'/'.join(_UNIT_SUFFIXES)}) — if it is genuinely "
+                "unitless, add it to _UNITLESS_HISTOGRAMS with a reason")
+        for label in m.labelnames:
+            if label in _RESERVED_LABELS:
+                problems.append(f"{where}: {name}: label {label!r} is "
+                                "reserved by the exposition format")
+            if not _LABEL_LINT.match(label):
+                problems.append(f"{where}: {name}: label {label!r} not "
+                                "snake_case")
+        snap = m.snapshot()
+        n_series = len(snap.get("series", ()))
+        if n_series > _MAX_SERIES_PER_METRIC:
+            problems.append(
+                f"{where}: {name}: {n_series} labelsets (> "
+                f"{_MAX_SERIES_PER_METRIC}) — unbounded label "
+                "cardinality?")
+    return problems
+
+
+class TestMetricsConventions:
+    """Tier-1 lint of the exposition contract: every metric any layer
+    registers must keep the naming/label invariants, so new
+    instrumentation cannot silently break scrapers."""
+
+    def test_default_registry_obeys_conventions(self):
+        """Whatever this process registered into the process-default
+        registry so far (the full tier-1 run exercises most layers)."""
+        problems = _lint_registry(REGISTRY, "default")
+        assert not problems, "\n".join(problems)
+
+    def test_representative_families_obey_conventions(self):
+        """Deterministic coverage independent of test order: register
+        the elastic / tracing / xla / decode / serving metric families
+        into a fresh registry and lint them."""
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        from deeplearning4j_tpu.parallel import elastic
+        from deeplearning4j_tpu.serving.decode import (DecodeScheduler,
+                                                       PagedDecodeEngine)
+        from deeplearning4j_tpu.util import tracing, xla
+
+        reg = MetricsRegistry()
+        elastic.rounds_counter(reg)
+        elastic.round_seconds_histogram(reg)
+        elastic.round_wait_seconds_histogram(reg)
+        elastic.staleness_gauge(reg)
+        elastic.transitions_counter(reg)
+        tracing.dropped_spans_counter(reg)
+        xla.compile_seconds_histogram(reg)
+        xla.compiled_flops_gauge(reg)
+        xla.compiled_bytes_gauge(reg)
+        # a scheduler construction registers the whole decode plane
+        # (goodput split included); no dispatch, so this is cheap
+        net = ComputationGraph(transformer_lm(
+            8, n_layers=1, d_model=8, n_heads=1, d_ff=16, seed=3,
+            input_ids=True, max_cache_t=16)).init()
+        engine = PagedDecodeEngine(net, max_batch=2, page_size=4,
+                                   pages_per_seq=4, registry=reg)
+        sched = DecodeScheduler(engine, registry=reg,
+                                start_thread=False)
+        problems = _lint_registry(reg, "representative")
+        assert not problems, "\n".join(problems)
+        assert reg.get("decode_goodput_tokens_total") is not None
+        assert sched is not None  # keep the weak gauges alive till here
