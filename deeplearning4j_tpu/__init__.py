@@ -22,9 +22,9 @@ __version__ = "0.1.0"
 
 # Lazy module surface: keep `import deeplearning4j_tpu` light.
 _SUBMODULES = {
-    "nn", "optimize", "eval", "datasets", "parallel", "models", "nlp",
-    "graph", "modelimport", "ui", "util", "ops", "losses", "dtypes", "rng",
-    "earlystopping", "clustering", "plot", "storage", "gradientcheck",
+    "nn", "optimize", "eval", "data", "datasets", "parallel", "models",
+    "nlp", "graph", "modelimport", "ui", "util", "ops", "losses", "dtypes",
+    "rng", "earlystopping", "clustering", "plot", "storage", "gradientcheck",
 }
 
 

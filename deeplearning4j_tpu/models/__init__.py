@@ -9,11 +9,11 @@ role); these builders produce the BASELINE.md configs:
 """
 
 from .lenet import lenet
-from .resnet import resnet, resnet50
+from .resnet import resnet, resnet50, resnet_tiny
 from .char_rnn import char_rnn_lstm
 from .classic import alexnet, deep_autoencoder, vgg16
 from .transformer import draft_transformer_lm, generate, transformer_lm
 
-__all__ = ["lenet", "resnet", "resnet50", "char_rnn_lstm",
+__all__ = ["lenet", "resnet", "resnet50", "resnet_tiny", "char_rnn_lstm",
            "alexnet", "vgg16", "deep_autoencoder", "transformer_lm",
            "draft_transformer_lm", "generate"]

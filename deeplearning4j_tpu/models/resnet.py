@@ -102,6 +102,17 @@ def resnet50(**kw):
     return resnet((3, 4, 6, 3), **kw)
 
 
+def resnet_tiny(*, height: int = 32, width: int = 32, channels: int = 3,
+                n_classes: int = 10, width_base: int = 16, **kw):
+    """Two-block bottleneck ResNet at CIFAR geometry: the CPU-harness
+    stand-in for the ResNet-50 bench path (same DAG shape — stem, stage
+    boundaries, projection shortcuts — at ~1/400th the FLOPs), used by
+    ``bench_input_pipeline`` and pipeline tests where compiling the full
+    ImageNet config would dominate the measurement."""
+    return resnet((1, 1), height=height, width=width, channels=channels,
+                  n_classes=n_classes, width_base=width_base, **kw)
+
+
 def fold_stem_7x7_to_s2d(w7: np.ndarray) -> np.ndarray:
     """Map 7×7/2 stem weights [7,7,C,O] (SAME pad → (2,3)) onto the exact
     equivalent 4×4/1 kernel [4,4,4C,O] over a 2×2 space-to-depth input

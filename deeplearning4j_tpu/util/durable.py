@@ -13,7 +13,11 @@ training state as table stakes (PAPERS.md):
   (``rng.fold_name(key(seed), f"update_{n}")``), and the **data-source
   cursor** (the ``state()``/``restore()`` seekable protocol implemented
   by the in-tree array, ``MultipleEpochs``, DataVec record-reader and
-  Async iterators). Restoring a snapshot replays zero batches and skips
+  Async iterators, and the sharded-record input pipeline —
+  ``data.pipeline.RecordDataSetIterator``, whose cursor carries shard
+  position, record offset, shuffle-buffer refs + rng state AND the
+  augmentation batch counter, so even random crop/flip draws replay
+  bit-exactly). Restoring a snapshot replays zero batches and skips
   none.
 - :class:`CheckpointStore` — multi-file snapshot directories committed
   atomically: files land in a ``.wip`` dir, a ``COMMIT`` marker with a

@@ -222,6 +222,35 @@ def host_gap_histogram(registry=None) -> _metrics.Histogram:
         buckets=_GAP_BUCKETS)
 
 
+def records_read_counter(registry=None) -> _metrics.Counter:
+    return _reg(registry).counter(
+        "pipeline_records_read_total",
+        "Records decoded from shard files by the record input pipeline "
+        "(data.pipeline)", ("stage",))
+
+
+def records_skipped_counter(registry=None) -> _metrics.Counter:
+    return _reg(registry).counter(
+        "pipeline_records_skipped_total",
+        "Corrupt records dropped by the skip-with-counter policy — any "
+        "nonzero value on a production run means a shard needs fsck",
+        ("stage",))
+
+
+def augment_seconds_counter(registry=None) -> _metrics.Counter:
+    return _reg(registry).counter(
+        "pipeline_augment_seconds_total",
+        "Producer-side seconds spent in the jitted augmentation stage "
+        "(host dispatch wall — the device compute overlaps the step)",
+        ("stage",))
+
+
+def pipeline_batches_counter(registry=None) -> _metrics.Counter:
+    return _reg(registry).counter(
+        "pipeline_batches_total",
+        "Batches assembled by the record input pipeline", ("stage",))
+
+
 def measured_flops_gauge(registry=None) -> _metrics.Gauge:
     return _reg(registry).gauge(
         "measured_flops_per_sec",

@@ -15,6 +15,9 @@ writes ``result.json`` (iteration/epoch counters, per-iteration scores,
 sha256 param digest) into the checkpoint directory on clean completion.
 
 Config keys: checkpoint_dir, total_epochs, frequency,
+records_dir (switches the child onto the sharded-record input pipeline:
+conv net + shard-shuffled, buffer-shuffled, jit-augmented record
+batches — the parent writes the shards with ``write_records`` first),
 kill_mode (None | "exit" | "sigterm" | "hang"), kill_at_iteration, seed,
 watchdog_s (arms DurableTrainer's StepWatchdog — pair with "hang", which
 sleeps forever at the step seam so the watchdog's monitor thread must
@@ -79,6 +82,68 @@ def build_iterator(seed: int = 7):
     return ListDataSetIterator(
         [DataSet(x[i * BATCH:(i + 1) * BATCH], y[i * BATCH:(i + 1) * BATCH])
          for i in range(N_BATCHES)], batch_size=BATCH)
+
+
+# ----------------------------------------------------------------------
+# records mode: sharded-record pipeline + jit augmentation under kill
+# ----------------------------------------------------------------------
+# A records_dir in the config switches the child onto the full input
+# pipeline: uint8 image records in 3 shards, epoch-seeded shard shuffle,
+# a shuffle buffer, and the jitted crop/flip/normalize augmentation —
+# so the kill/resume proof covers the pipeline cursor AND the
+# counter-derived augmentation rng, not just a list iterator's index.
+
+REC_SHARDS = 3
+REC_IMAGE = 3           # [3, 3, 1] uint8 images
+
+
+def build_conv_net(seed: int = 7):
+    """Tiny conv net matching the records' image shape (the dense
+    build_net expects flat features; augmentation needs NHWC)."""
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                   GlobalPoolingLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater("adam")
+            .learning_rate(0.01).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(2, 2),
+                                    border_mode="same", activation="relu"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=CLASSES, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(REC_IMAGE, REC_IMAGE, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def write_records(records_dir: str, seed: int = 7):
+    import numpy as np
+    from deeplearning4j_tpu.data.records import write_shard_set
+
+    rng = np.random.default_rng(seed)
+    n = N_BATCHES * BATCH
+    imgs = rng.integers(0, 256, (n, REC_IMAGE, REC_IMAGE, 1),
+                        dtype=np.uint8)
+    labels = np.eye(CLASSES, dtype=np.float32)[
+        rng.integers(0, CLASSES, n)]
+    return write_shard_set(
+        records_dir, "toy",
+        [{"features": imgs[i], "labels": labels[i]} for i in range(n)],
+        REC_SHARDS)
+
+
+def build_records_iterator(records_dir: str, seed: int = 7):
+    from deeplearning4j_tpu.data.pipeline import (Augment,
+                                                  RecordDataSetIterator)
+
+    return RecordDataSetIterator(
+        records_dir, "toy", batch_size=BATCH, seed=seed,
+        shuffle_shards=True, shuffle_buffer=12,
+        augment=Augment(crop_pad=1, flip=True, scale=1 / 255.0,
+                        mean=(0.5,), std=(0.25,)))
 
 
 def params_sha(net) -> str:
@@ -397,8 +462,11 @@ def _child_main(config: dict) -> None:
     # the black box lands next to the checkpoints, where the parent looks
     os.environ["DL4JTPU_FLIGHT_DIR"] = directory
 
+    records_dir = config.get("records_dir")
+    net = (build_conv_net(config.get("seed", 7)) if records_dir
+           else build_net(config.get("seed", 7)))
     trainer = DurableTrainer(
-        build_net(config.get("seed", 7)), directory,
+        net, directory,
         frequency=config.get("frequency", 2), handle_signals=True,
         async_writes=config.get("async", True),
         watchdog_s=config.get("watchdog_s"))
@@ -433,9 +501,10 @@ def _child_main(config: dict) -> None:
     plan = faults.FaultPlan()
     _install_kill_plan(plan, config)
 
+    data = (build_records_iterator(records_dir, config.get("seed", 7))
+            if records_dir else build_iterator(config.get("seed", 7)))
     with plan.active():
-        trainer.fit(build_iterator(config.get("seed", 7)),
-                    epochs=config["total_epochs"])
+        trainer.fit(data, epochs=config["total_epochs"])
 
     result = {
         "iteration_count": trainer.net.iteration_count,

@@ -1,0 +1,534 @@
+"""Sharded-record input pipeline (ISSUE 14): format, fsck, per-host
+shard assignment, deterministic shuffles, the jit augmentation stage,
+and the seekable cursor — plus the fork-and-kill chaos proof that a
+mid-epoch preemption through ``DurableSession`` resumes to a
+bit-identical batch stream (augmentation rng included).
+
+Budget note: the shard fixtures are module-scoped (write once, read
+many) and every dataset here is tiny — the only deliberately expensive
+test is the single-subprocess kill/resume chaos run.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import _kill_harness as harness
+from deeplearning4j_tpu.data.pipeline import (Augment, RecordDataSetIterator,
+                                              assignment_for_round,
+                                              shard_assignment)
+from deeplearning4j_tpu.data.records import (RecordCorruptError,
+                                             RecordFormatError, ShardReader,
+                                             ShardSet, ShardSetError,
+                                             decode_example, encode_example,
+                                             fsck, format_report,
+                                             shard_filename, write_shard_set)
+
+N_EXAMPLES = 23
+N_SHARDS = 4
+IMG = (4, 4, 1)
+
+
+def _examples(n=N_EXAMPLES, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"features": rng.integers(0, 256, IMG, dtype=np.uint8),
+             "labels": np.eye(3, dtype=np.float32)[i % 3],
+             "id": np.asarray(i, dtype=np.int64)}
+            for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    """One clean shard set, written once for the whole module. Tests that
+    corrupt files copy OUT of here first — never mutate in place."""
+    d = str(tmp_path_factory.mktemp("records"))
+    write_shard_set(d, "toy", _examples(), N_SHARDS)
+    return d
+
+
+def _copy_set(src, dst):
+    os.makedirs(dst, exist_ok=True)
+    for fn in os.listdir(src):
+        if fn.endswith(".rec"):
+            shutil.copy(os.path.join(src, fn), os.path.join(dst, fn))
+
+
+# ----------------------------------------------------------------------
+# format
+# ----------------------------------------------------------------------
+
+class TestRecordFormat:
+    def test_example_serde_roundtrip_dtypes(self):
+        ex = {"u8": np.arange(12, dtype=np.uint8).reshape(3, 4),
+              "f32": np.linspace(0, 1, 5, dtype=np.float32),
+              "f64": np.array([[1.5, -2.5]], dtype=np.float64),
+              "i64": np.asarray(-7, dtype=np.int64)}
+        out = decode_example(encode_example(ex))
+        assert set(out) == set(ex)
+        for k in ex:
+            assert out[k].dtype == ex[k].dtype
+            assert out[k].shape == np.asarray(ex[k]).shape
+            np.testing.assert_array_equal(out[k], ex[k])
+
+    def test_write_read_roundtrip_and_seek(self, shard_dir):
+        s = ShardSet(shard_dir, "toy")
+        assert s.num_shards == N_SHARDS
+        assert s.total_records() == N_EXAMPLES
+        exs = _examples()
+        # round-robin split: example i lives at (shard i%N, record i//N)
+        for i in (0, 5, 13, 22):
+            got = decode_example(s.reader(i % N_SHARDS).read(i // N_SHARDS))
+            np.testing.assert_array_equal(got["features"],
+                                          exs[i]["features"])
+            assert int(got["id"]) == i
+        # O(1) seek order is arbitrary
+        r = s.reader(2)
+        back = [int(decode_example(r.read(i))["id"])
+                for i in reversed(range(len(r)))]
+        assert back == sorted(back, reverse=True)
+
+    def test_contiguous_split_preserves_order(self, tmp_path):
+        write_shard_set(str(tmp_path), "seq", _examples(10, seed=1), 3,
+                        split="contiguous")
+        s = ShardSet(str(tmp_path), "seq")
+        ids = [int(decode_example(p)["id"])
+               for i in range(3) for _, p in s.reader(i)]
+        assert ids == list(range(10))
+
+    def test_writer_crash_leaves_no_rec_file(self, tmp_path):
+        class Boom(Exception):
+            pass
+
+        def gen():
+            yield {"x": np.zeros(3, np.float32)}
+            raise Boom
+
+        with pytest.raises(Boom):
+            write_shard_set(str(tmp_path), "torn", gen(), 2)
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".rec")]
+
+
+# ----------------------------------------------------------------------
+# chaos: torn / corrupt / incomplete shard sets
+# ----------------------------------------------------------------------
+
+class TestShardChaos:
+    def test_missing_shard_refused_at_open(self, shard_dir, tmp_path):
+        d = str(tmp_path / "missing")
+        _copy_set(shard_dir, d)
+        os.remove(os.path.join(d, shard_filename("toy", 2, N_SHARDS)))
+        with pytest.raises(ShardSetError, match=r"missing shard\(s\) \[2\]"):
+            ShardSet(d, "toy")
+        rep = fsck(d)
+        assert not rep["ok"]
+        assert any("missing shard(s) [2]" in e
+                   for e in rep["sets"]["toy"]["errors"])
+
+    def test_truncated_shard_refused_at_open(self, shard_dir, tmp_path):
+        """Tail truncation (a torn copy / partial upload) takes the index
+        footer with it: the WHOLE shard is refused, not silently read up
+        to the tear."""
+        d = str(tmp_path / "trunc")
+        _copy_set(shard_dir, d)
+        victim = os.path.join(d, shard_filename("toy", 1, N_SHARDS))
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(size - 11)        # mid final record/index region
+        with pytest.raises(RecordFormatError, match="no index footer"):
+            ShardReader(victim)
+        with pytest.raises(RecordFormatError):
+            ShardSet(d, "toy").reader(1)
+        rep = fsck(d)
+        assert not rep["ok"]
+        bad = rep["sets"]["toy"]["shards"][os.path.basename(victim)]
+        assert "no index footer" in bad["error"]
+
+    @pytest.fixture()
+    def flipped(self, shard_dir, tmp_path):
+        """A copy of the set with ONE payload byte flipped mid-record."""
+        d = str(tmp_path / "flip")
+        _copy_set(shard_dir, d)
+        victim = os.path.join(d, shard_filename("toy", 0, N_SHARDS))
+        clean = ShardReader(victim)
+        # flip a byte inside record 2's payload (offset + 8-byte header)
+        pos = clean.offsets[2] + 8 + 3
+        n_records = len(clean)
+        clean.close()
+        with open(victim, "r+b") as f:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return d, victim, n_records
+
+    def test_flipped_byte_crc_raise(self, flipped):
+        d, victim, _ = flipped
+        r = ShardReader(victim)                     # structure still valid
+        assert r.read(1) is not None                # neighbors fine
+        with pytest.raises(RecordCorruptError, match="record 2: crc32"):
+            r.read(2)
+
+    def test_flipped_byte_crc_skip_with_counter(self, flipped):
+        d, victim, n_records = flipped
+        r = ShardReader(victim, corrupt="skip")
+        good = [i for i, _ in r]
+        assert len(good) == n_records - 1 and 2 not in good
+        assert r.skipped == 1
+        rep = fsck(d)
+        assert not rep["ok"]
+        shard = rep["sets"]["toy"]["shards"][os.path.basename(victim)]
+        assert shard["bad_records"] == 1
+
+    def test_pipeline_skip_policy_counts_into_registry(self, flipped):
+        from deeplearning4j_tpu.util.metrics import MetricsRegistry
+        d, _, _ = flipped
+        reg = MetricsRegistry()
+        it = RecordDataSetIterator(d, "toy", batch_size=4,
+                                   shuffle_shards=False, corrupt="skip",
+                                   stage_name="chaos", registry=reg)
+        total = 0
+        while it.has_next():
+            total += int(np.asarray(it.next().features).shape[0])
+        assert total == N_EXAMPLES - 1
+        assert reg.get("pipeline_records_skipped_total").value(
+            stage="chaos") == 1
+
+    def test_corrupt_tail_ends_stream_cleanly(self, tmp_path):
+        """Skip policy with EVERY tail record corrupt: has_next() cannot
+        see past unread corruption, so the final next() comes up short —
+        iteration must end cleanly (no PEP-479 RuntimeError), with the
+        good prefix delivered and the skips counted."""
+        d = str(tmp_path)
+        write_shard_set(d, "t", _examples(10, seed=2), 2,
+                        split="contiguous")
+        victim = os.path.join(d, shard_filename("t", 1, 2))
+        r = ShardReader(victim)
+        offsets = list(r.offsets)
+        r.close()
+        with open(victim, "r+b") as f:
+            for off in offsets:
+                f.seek(off + 8)
+                b = f.read(1)
+                f.seek(off + 8)
+                f.write(bytes([b[0] ^ 0xFF]))
+        from deeplearning4j_tpu.util.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        it = RecordDataSetIterator(d, "t", batch_size=4,
+                                   shuffle_shards=False, corrupt="skip",
+                                   stage_name="tail", registry=reg)
+        sizes = [np.asarray(b.features).shape[0] for b in it]
+        assert sum(sizes) == 10 - len(offsets)
+        assert it._set.skipped == len(offsets)
+        # skips discovered by the FINAL (empty) next() still reach the
+        # registry — monitoring must see a corrupt tail
+        assert reg.get("pipeline_records_skipped_total").value(
+            stage="tail") == len(offsets)
+
+    def test_fsck_cli_exit_codes(self, shard_dir, tmp_path):
+        """The module CLI: exit 0 on a clean set, nonzero with a report
+        on damage (the tooling the chaos story hands operators)."""
+        from deeplearning4j_tpu.data import records as records_mod
+        assert records_mod.main(["--fsck", shard_dir]) == 0
+        d = str(tmp_path / "cli")
+        _copy_set(shard_dir, d)
+        os.remove(os.path.join(d, shard_filename("toy", 0, N_SHARDS)))
+        assert records_mod.main(["--fsck", d]) == 1
+        # the real entry point once (jax-free import path: cheap)
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.data.records",
+             "--fsck", d],
+            capture_output=True, text=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert proc.returncode == 1
+        assert "FSCK FAILED" in proc.stdout
+        assert "missing shard(s) [0]" in proc.stdout
+
+
+# ----------------------------------------------------------------------
+# per-host shard assignment
+# ----------------------------------------------------------------------
+
+class TestShardAssignment:
+    @pytest.mark.parametrize("n_hosts", [1, 2, 4])
+    @pytest.mark.parametrize("num_shards", [4, 7, 16])
+    def test_disjoint_covering_deterministic(self, n_hosts, num_shards):
+        hosts = [f"h{i}" for i in range(n_hosts)]
+        parts = [shard_assignment(num_shards, hosts, h) for h in hosts]
+        flat = sorted(s for p in parts for s in p)
+        assert flat == list(range(num_shards))          # disjoint + covering
+        assert all(p for p in parts)                    # nobody starves
+        # pure function: same answer again, and member ORDER is irrelevant
+        assert parts == [shard_assignment(num_shards, list(reversed(hosts)),
+                                          h) for h in hosts]
+
+    def test_fewer_shards_than_hosts_refused(self):
+        with pytest.raises(ValueError, match="cannot feed"):
+            shard_assignment(2, ["h0", "h1", "h2"], "h0")
+
+    def test_unknown_host_refused(self):
+        with pytest.raises(ValueError, match="not in members"):
+            shard_assignment(4, ["h0", "h1"], "h9")
+
+    def test_stable_under_elastic_membership_log(self):
+        """The elastic tie-in: the member set comes from the membership
+        log's effective rounds, so an eviction reassigns shards
+        deterministically at the round it binds — and every surviving
+        host computes the identical post-eviction partition."""
+        from deeplearning4j_tpu.parallel.elastic import (
+            ElasticConfig, ElasticCoordinator, InMemoryCoordinationStore)
+        from deeplearning4j_tpu.util.metrics import MetricsRegistry
+
+        fleet = ("h0", "h1", "h2", "h3")
+        coord = ElasticCoordinator(
+            InMemoryCoordinationStore(),
+            ElasticConfig(fleet=fleet, host="h0", steps_per_round=1),
+            registry=MetricsRegistry())
+        coord._append_log("evict", "h1", 3)
+
+        def partition(round_, members):
+            parts = {h: assignment_for_round(8, coord, round_, h)
+                     for h in members}
+            flat = sorted(s for p in parts.values() for s in p)
+            assert flat == list(range(8))
+            return parts
+
+        before = partition(2, fleet)
+        assert len(before) == 4
+        after = partition(3, ("h0", "h2", "h3"))
+        # the evicted host owns nothing after its effective round...
+        with pytest.raises(ValueError, match="not in members"):
+            assignment_for_round(8, coord, 3, "h1")
+        # ...and the reassignment is deterministic (recompute == same)
+        assert after == partition(5, ("h0", "h2", "h3"))
+
+
+# ----------------------------------------------------------------------
+# pipeline: shuffles, augmentation, cursor
+# ----------------------------------------------------------------------
+
+def _drain(it):
+    out = []
+    while it.has_next():
+        b = it.next()
+        out.append((np.asarray(b.features), np.asarray(b.labels)))
+    return out
+
+
+def _make(shard_dir, **kw):
+    kw.setdefault("batch_size", 5)
+    kw.setdefault("seed", 3)
+    kw.setdefault("shuffle_shards", True)
+    kw.setdefault("shuffle_buffer", 6)
+    return RecordDataSetIterator(shard_dir, "toy", **kw)
+
+
+class TestRecordPipeline:
+    def test_epoch_covers_every_record_once(self, shard_dir):
+        it = RecordDataSetIterator(shard_dir, "toy", batch_size=4, seed=1,
+                                   shuffle_shards=True, shuffle_buffer=8,
+                                   features_key="id", labels_key=None)
+        ids = []
+        while it.has_next():
+            ids.extend(int(v) for v in np.asarray(it.next().features))
+        assert sorted(ids) == list(range(N_EXAMPLES))
+
+    def test_two_hosts_partition_the_dataset(self, shard_dir):
+        seen = {}
+        for h in ("h0", "h1"):
+            it = RecordDataSetIterator(
+                shard_dir, "toy", batch_size=4, seed=1, hosts=("h0", "h1"),
+                host=h, shuffle_shards=True, shuffle_buffer=4,
+                features_key="id", labels_key=None)
+            seen[h] = {int(v) for b in _drain(it) for v in b[0]}
+        assert seen["h0"] & seen["h1"] == set()
+        assert seen["h0"] | seen["h1"] == set(range(N_EXAMPLES))
+
+    def test_stream_deterministic_and_epochs_differ(self, shard_dir):
+        a, b = _make(shard_dir), _make(shard_dir)
+        ea = _drain(a)
+        for fa, la in ea:
+            nb = b.next()
+            np.testing.assert_array_equal(fa, np.asarray(nb.features))
+            np.testing.assert_array_equal(la, np.asarray(nb.labels))
+        a.reset()
+        b.reset()
+        ea2 = _drain(a)
+        assert all(np.array_equal(f1, f2) for (f1, _), (f2, _)
+                   in zip(ea2, _drain(b)))
+        # epoch-seeded shuffle: epoch 1's stream is a different order
+        assert not all(np.array_equal(f1, f2)
+                       for (f1, _), (f2, _) in zip(ea, ea2))
+
+    def test_reshuffle_off_replays_the_epoch(self, shard_dir):
+        it = _make(shard_dir, reshuffle_each_epoch=False)
+        first = _drain(it)
+        it.reset()
+        again = _drain(it)
+        assert all(np.array_equal(f1, f2)
+                   for (f1, _), (f2, _) in zip(first, again))
+
+    def test_drop_remainder(self, shard_dir):
+        it = _make(shard_dir, drop_remainder=True)       # 23 % 5 = 3 dropped
+        batches = _drain(it)
+        assert [b[0].shape[0] for b in batches] == [5, 5, 5, 5]
+
+    def test_augment_normalize_math_and_determinism(self, shard_dir):
+        aug = Augment(scale=1 / 255.0, mean=(0.5,), std=(0.25,))
+        it = _make(shard_dir, shuffle_shards=False, shuffle_buffer=0,
+                   augment=aug)
+        raw = _make(shard_dir, shuffle_shards=False, shuffle_buffer=0)
+        got = np.asarray(it.next().features)
+        want = (np.asarray(raw.next().features).astype(np.float32)
+                / 255.0 - 0.5) / 0.25
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_augment_crop_flip_seeded_by_batch_counter(self, shard_dir):
+        """Crop/flip draws are a pure function of (seed, batch counter):
+        two iterators agree batch for batch, and the SAME input batch
+        re-augmented under a different counter draws differently."""
+        aug = Augment(crop_pad=1, flip=True, scale=1 / 255.0)
+        a = _make(shard_dir, augment=aug)
+        b = _make(shard_dir, augment=aug)
+        fa, fb = np.asarray(a.next().features), np.asarray(b.next().features)
+        assert fa.shape == (5,) + IMG             # crop returns to H, W
+        np.testing.assert_array_equal(fa, fb)     # same counter, same draws
+        stage = a._augment
+        raw = np.asarray(_make(shard_dir).next().features)
+        one = np.asarray(stage(raw, 100))
+        two = np.asarray(stage(raw, 101))
+        np.testing.assert_array_equal(one, np.asarray(stage(raw, 100)))
+        assert not np.array_equal(one, two)
+
+    def test_augment_rejects_flat_features_for_crop(self, shard_dir):
+        it = _make(shard_dir, features_key="labels", labels_key="id",
+                   augment=Augment(flip=True))
+        with pytest.raises(ValueError, match="NHWC"):
+            it.next()
+
+    def test_cursor_restore_bit_identical_with_augment(self, shard_dir):
+        """The resume acceptance at pipeline level: consume k batches,
+        snapshot, rebuild a FRESH iterator, restore — the remaining
+        stream (shuffled, augmented) is bit-identical to an uninterrupted
+        run, through a JSON round-trip of the cursor (exactly what the
+        checkpoint store does to it)."""
+        aug = Augment(crop_pad=1, flip=True, scale=1 / 255.0)
+        run = _make(shard_dir, augment=aug)
+        ref = _make(shard_dir, augment=aug)
+        for _ in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(run.next().features),
+                np.asarray(ref.next().features))
+        cursor = json.loads(json.dumps(run.state()))
+        resumed = _make(shard_dir, augment=aug)
+        resumed.restore(cursor)
+        tail = 0
+        while ref.has_next():
+            assert resumed.has_next()
+            np.testing.assert_array_equal(
+                np.asarray(resumed.next().features),
+                np.asarray(ref.next().features))
+            tail += 1
+        assert not resumed.has_next() and tail > 0
+
+    def test_cursor_restore_across_epoch_boundary(self, shard_dir):
+        run, ref = _make(shard_dir), _make(shard_dir)
+        _drain(run), _drain(ref)
+        run.reset(), ref.reset()
+        run.next(), ref.next()
+        resumed = _make(shard_dir)
+        resumed.restore(run.state())
+        for f, _ in _drain(ref):
+            np.testing.assert_array_equal(
+                f, np.asarray(resumed.next().features))
+
+    def test_cursor_config_mismatch_refused(self, shard_dir, tmp_path):
+        it = _make(shard_dir)
+        it.next()
+        st = it.state()
+        other = _make(shard_dir, shuffle_buffer=0)
+        with pytest.raises(ValueError, match="shuffle_buffer=0"):
+            other.restore(st)
+        d2 = str(tmp_path / "other")
+        write_shard_set(d2, "toy", _examples(12, seed=9), 2)
+        with pytest.raises(ValueError, match="different pipeline"):
+            _make(d2).restore(st)
+        # same host name + shard count but a different MEMBER SET changes
+        # the assignment — restoring would silently read other hosts'
+        # records, so it must be refused
+        resized = RecordDataSetIterator(
+            shard_dir, "toy", batch_size=5, seed=3, shuffle_shards=True,
+            shuffle_buffer=6, hosts=("host0", "host1"), host="host0")
+        with pytest.raises(ValueError, match="fleet membership"):
+            resized.restore(st)
+
+
+# ----------------------------------------------------------------------
+# chaos: kill mid-epoch through DurableSession
+# ----------------------------------------------------------------------
+
+class _Scores:
+    def __init__(self, sink):
+        self.sink = sink
+
+    def iteration_done(self, model, iteration, score):
+        self.sink.append(float(score))
+
+    def on_epoch_start(self, *a):
+        pass
+
+    def on_epoch_end(self, *a):
+        pass
+
+    def on_forward_pass(self, *a):
+        pass
+
+    def on_gradient_calculation(self, *a):
+        pass
+
+    def on_backward_pass(self, *a):
+        pass
+
+
+@pytest.mark.chaos
+class TestKillMidEpochRecords:
+    def test_sigterm_midepoch_resumes_bit_identical(self, tmp_path):
+        """The ISSUE 14 acceptance: a records-fed run (shard shuffle +
+        shuffle buffer + jitted crop/flip augmentation) self-SIGTERMs
+        mid-epoch-1 in a SUBPROCESS (fresh jit caches, the honest
+        preemption); the in-process resume restores the pipeline cursor
+        through ``DurableTrainer`` and lands on the exact loss trajectory
+        and final params of an uninterrupted run — which requires every
+        shuffle draw AND every augmentation draw to replay bit-exactly."""
+        from deeplearning4j_tpu.util.durable import DurableTrainer
+
+        rec = str(tmp_path / "records")
+        ck = str(tmp_path / "ckpt")
+        harness.write_records(rec)
+        rc, err = harness.run_child({
+            "checkpoint_dir": ck, "total_epochs": 2, "frequency": 2,
+            "kill_mode": "sigterm", "kill_at_iteration": 8,
+            "records_dir": rec})
+        assert rc == 0, err
+        result = json.load(open(os.path.join(ck, "result.json")))
+        assert result["preempted"]
+        assert result["iteration_count"] == 9        # killed mid-epoch 1
+
+        t2 = DurableTrainer(harness.build_conv_net(), ck, frequency=100,
+                            handle_signals=False)
+        assert t2.resumed and t2.net.iteration_count == 9
+        scores = list(result["scores"])
+        t2.net.add_listener(_Scores(scores))
+        t2.fit(harness.build_records_iterator(rec), epochs=2)
+
+        ref = harness.build_conv_net()
+        ref_scores = []
+        ref.add_listener(_Scores(ref_scores))
+        ref.fit(harness.build_records_iterator(rec), epochs=2)
+
+        assert scores == ref_scores
+        assert harness.params_sha(t2.net) == harness.params_sha(ref)
