@@ -587,6 +587,77 @@ def bench_checkpoint() -> dict:
     return out
 
 
+def bench_health_stats() -> dict:
+    """On-device training-health stats A/B (ISSUE 15 acceptance): the
+    SAME model/batch trained with the plain train step vs the
+    stats-collecting variant (per-layer norms, update:param ratios,
+    activation stats, log-bucket histograms fused into the dispatch).
+    Acceptance: ``health_stats_overhead_pct`` ≤ 2% with ZERO added host
+    syncs outside listener windows (nothing reads the stats pytree until
+    a consumer asks). A second phase attaches a ``HealthListener`` at
+    ``frequency`` and pins exactly one sync per window, reporting the
+    rules engine's verdicts as the ``training_health`` payload field."""
+    import jax
+    from deeplearning4j_tpu.models import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.util import health as _health
+    from deeplearning4j_tpu.util.ingest import sync_counter
+
+    batch = int(os.environ.get("BENCH_HEALTH_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_HEALTH_STEPS", "60"))
+    rounds = int(os.environ.get("BENCH_HEALTH_ROUNDS", "3"))
+    xs, ys = _stage_batches(1, batch, (784,), 10, seed=37)
+    x, y = jax.device_put(xs[0]), jax.device_put(ys[0])
+
+    def arm(stats: bool) -> float:
+        """Best-of-rounds steady-state fit_batch step time (ms)."""
+        net = MultiLayerNetwork(lenet()).init()
+        if stats:
+            net.enable_health_stats()
+        net.fit_batch(x, y)                   # warmup/compile
+        np.asarray(net._score)
+        best = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                net.fit_batch(x, y)
+            np.asarray(net._score)            # completion barrier
+            dt = 1000 * (time.perf_counter() - t0) / steps
+            best = dt if best is None else min(best, dt)
+        return best
+
+    off_ms = arm(False)
+    s0 = sync_counter().total()
+    on_ms = arm(True)           # listener-free: nothing reads the stats
+    syncs_outside_windows = sync_counter().total() - s0
+
+    # listener phase: one sync per frequency window, rules evaluated
+    freq = int(os.environ.get("BENCH_HEALTH_FREQ", "10"))
+    net = MultiLayerNetwork(lenet()).init()
+    listener = _health.HealthListener(frequency=freq, model="bench_lenet")
+    net.set_listeners(listener)
+    net.fit_batch(x, y)                       # warmup (enables stats)
+    np.asarray(net._score)
+    s0 = sync_counter().total()
+    n = 3 * freq
+    it0 = net.iteration_count
+    for _ in range(n):
+        net.fit_batch(x, y)
+    np.asarray(net._score)
+    listener_syncs = sync_counter().total() - s0
+    windows = sum(1 for i in range(it0 + 1, it0 + n + 1) if i % freq == 0)
+
+    return {
+        "step_ms_off": round(off_ms, 3), "step_ms_on": round(on_ms, 3),
+        "health_stats_overhead_pct": round(
+            100 * (on_ms - off_ms) / off_ms, 2),
+        "syncs_outside_windows": syncs_outside_windows,
+        "listener_windows": windows, "listener_syncs": listener_syncs,
+        "batch": batch, "steps": steps,
+        "training_health": listener.engine.last_report,
+    }
+
+
 def bench_lstm() -> dict:
     """Char-RNN GravesLSTM (BASELINE config #3): tokens/s through
     MultiLayerNetwork.fit_repeated on one-hot char sequences."""
@@ -1131,6 +1202,7 @@ def main() -> None:
     _run_config(out, "ingest", bench_ingest)
     input_res = _run_config(out, "input_pipeline", bench_input_pipeline)
     _run_config(out, "checkpoint", bench_checkpoint)
+    health_res = _run_config(out, "health_stats", bench_health_stats)
     _run_config(out, "lstm", bench_lstm)
     _run_config(out, "word2vec", bench_word2vec)
     _run_config(out, "flash_attention", bench_flash_attention)
@@ -1218,6 +1290,21 @@ def main() -> None:
             "augment_ms_per_batch": input_res["augment_ms_per_batch"],
         }
         out["input_host_gap_pct"] = input_res["gap_pct_records"]
+
+    # training-health telemetry row (ISSUE 15): stats-on-vs-off overhead
+    # (acceptance ≤2%, same bar family as tracing's ≤1%) plus the rules
+    # engine's verdicts from the listener phase — the round's evidence
+    # that model-internals observability rides inside the train dispatch
+    if health_res is not None and "health_stats_overhead_pct" in health_res:
+        out["health_stats_overhead_pct"] = health_res[
+            "health_stats_overhead_pct"]
+        out["training_health"] = {
+            "overhead_pct": health_res["health_stats_overhead_pct"],
+            "syncs_outside_windows": health_res["syncs_outside_windows"],
+            "listener_windows": health_res["listener_windows"],
+            "listener_syncs": health_res["listener_syncs"],
+            "report": health_res.get("training_health"),
+        }
 
     # transformer flagship row: a SECOND named metric alongside the
     # ResNet headline (which keeps the vs_baseline trajectory unbroken);

@@ -25,6 +25,7 @@ from .. import dtypes as _dtypes
 from .. import losses as _losses
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
+from ..util import health as _health
 from ..util import xla as _xla
 from ..util.netutil import note_streamed_steps as _note_streamed_steps
 from ..util.netutil import precheck_streamed_steps as _precheck_streamed_steps
@@ -59,6 +60,11 @@ class ComputationGraph:
         self._rnn_state: Optional[Dict[str, Dict[str, jax.Array]]] = None
         self._rnn_steps_fed = 0    # streaming steps since last cache reset
         self._jit_cache: Dict[str, Any] = {}
+        # on-device training-health stats (util.health): None = off (the
+        # default; the no-stats trace is untouched), a StatsConfig routes
+        # fit_batch/fit_scan through the stats-collecting step variant
+        self.health_stats: Optional[_health.StatsConfig] = None
+        self._last_health_stats: Optional[_health.DeviceStats] = None
 
         self._output_layer_names = [
             n for n in conf.network_outputs
@@ -369,12 +375,19 @@ class ComputationGraph:
     # loss (parity: computeGradientAndScore :912 — score summed over outputs)
     # ------------------------------------------------------------------
 
-    def _loss_fn(self, params, states, inputs, labels, masks, rng):
+    def _loss_fn(self, params, states, inputs, labels, masks, rng, *,
+                 collect_stats=False):
+        # collect_stats: falsy = plain loss; True or a health.StatsConfig
+        # (whose act_sample bounds the activation reductions) additionally
+        # returns per-vertex activation summaries through the aux output
         if not self._output_layer_names:
             raise ValueError(
                 "no output vertex has a loss (need OutputLayer/RnnOutputLayer/"
                 "LossLayer at a network output to train)")
-        if self.training.gradient_checkpointing:
+        # stats collection summarizes every vertex activation in the main
+        # walk — it bypasses the remat path (same trade as the sequential
+        # runtime: visibility over the memory saving)
+        if self.training.gradient_checkpointing and not collect_stats:
             if masks is None or all(m is None for m in masks):
                 return self._loss_fn_segmented(params, states, inputs,
                                                labels, rng)
@@ -400,6 +413,7 @@ class ComputationGraph:
         # layers with consumers); XLA CSE merges the duplicated layer forward
         consumed = {i for ins in self.conf.vertex_inputs.values() for i in ins}
         mbs = self._minibatch_map(inputs[0].shape[0])
+        act_stats: Dict[str, Dict[str, jax.Array]] = {}
         total = 0.0
         for name in self.topo_order:
             in_names = self.conf.vertex_inputs[name]
@@ -420,6 +434,9 @@ class ComputationGraph:
                 mask_map[name] = self.conf.vertices[name].output_mask(
                     in_masks, minibatch=acts[in_names[0]].shape[0])
                 new_states[name] = st
+                if collect_stats:
+                    act_stats[name] = _health.act_summary(
+                        out, getattr(collect_stats, "act_sample", 0))
             else:
                 new_states[name] = {}
         total = total + self._reg_penalty(params)
@@ -430,6 +447,8 @@ class ComputationGraph:
                 total = total + st["aux_loss"]
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)
+        if collect_stats:
+            return total.astype(loss_dtype), (new_states, act_stats)
         return total.astype(loss_dtype), new_states
 
     def _output_score(self, params, name, hidden, y, mask, vrng=None,
@@ -526,37 +545,64 @@ class ComputationGraph:
     # the jitted train step + fit
     # ------------------------------------------------------------------
 
-    def _make_train_step(self):
+    def _make_train_step(self, stats_cfg: Optional[_health.StatsConfig] = None):
         t = self.training
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
+        collect = stats_cfg is not None
 
         def step(params, opt_state, states, inputs, labels, masks, rng, it):
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(
-                    params, states, inputs, labels, masks, rng)
-            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            loss, new_states, grads_raw, act_stats = \
+                _health.value_grad_with_stats(
+                    self._loss_fn, stats_cfg, params, states, inputs,
+                    labels, masks, rng)
+            grads = _updaters.normalize_gradients(grads_raw, norm_kind,
+                                                  norm_thr)
             deltas, opt_state = updater.update(grads, opt_state, it)
             params = _updaters.apply_updates(params, deltas)
-            return params, opt_state, new_states, loss
+            if not collect:
+                return params, opt_state, new_states, loss
+            # per-layer health stats in the SAME dispatch: raw (pre-norm)
+            # grads, the applied deltas, and the post-update params
+            stats = _health.model_stats(params, grads_raw, deltas,
+                                        act_stats, stats_cfg, loss=loss)
+            return params, opt_state, new_states, loss, stats
 
         return jax.jit(step, donate_argnums=(0, 1),
                        compiler_options=_xla.train_step_options())
 
     def _train_step(self):
         # explicit override first (ParallelWrapper installs its sharded
-        # SPMD step here; an override is pinned, not trace-env-keyed)
+        # SPMD step here; an override is pinned, not trace-env-keyed and
+        # not stats-keyed — sharded steps do not collect health stats)
         fn = self._jit_cache.get("train_step_override")
         if fn is not None:
             return fn
-        cache_key = f"train_step@{_xla.trace_env_key()}"
+        cfg = self.health_stats
+        suffix = "" if cfg is None else f"|stats={cfg.trace_key()}"
+        cache_key = f"train_step@{_xla.trace_env_key()}{suffix}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = _xla.retrace_guard(self._make_train_step(),
-                                    "ComputationGraph.train_step")
+            # distinct guard name for the stats variant: the no-stats
+            # trace's retrace pin must not move when stats toggle
+            name = ("ComputationGraph.train_step" if cfg is None
+                    else "ComputationGraph.train_step_stats")
+            fn = _xla.retrace_guard(self._make_train_step(cfg), name)
             self._jit_cache[cache_key] = fn
         return fn
+
+    def enable_health_stats(self, config=True) -> None:
+        """Compute per-layer training-health stats (util.health) INSIDE
+        the train dispatch from the next fit call on: the stats-keyed jit
+        cache traces a separate program, so the cached no-stats trace is
+        untouched and toggling back off reuses it without a recompile.
+        Consumers read :func:`util.health.latest_stats` — one host sync
+        per read, the snapshot carries the step loss."""
+        self.health_stats = _health.StatsConfig.coerce(config)
+
+    def disable_health_stats(self) -> None:
+        self.health_stats = None
 
     def set_listeners(self, *listeners) -> None:
         # Accept both varargs and a single collection (ref Model.setListeners
@@ -582,14 +628,16 @@ class ComputationGraph:
                 l.record_batch(batch_size)
             l.iteration_done(self, self.iteration_count, score)
 
-    def _make_train_scan(self):
+    def _make_train_scan(self, stats_cfg: Optional[_health.StatsConfig] = None):
         """K train steps fused into ONE lax.scan XLA program (same design as
-        MultiLayerNetwork._make_train_scan)."""
+        MultiLayerNetwork._make_train_scan). With ``stats_cfg`` the scan
+        also emits the health-stats pytree of the LAST step."""
         t = self.training
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
         base = _rng.key(t.seed)
+        collect = stats_cfg is not None
 
         def one(carry, batch):
             params, opt_state, states, it = carry
@@ -598,22 +646,33 @@ class ComputationGraph:
             # eagerly from the host-side update count bakes fresh constants
             # into the program and forces a recompile every call
             rng = jax.random.fold_in(base, it)
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(
-                    params, states, xs, ys, masks, rng)
-            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            loss, new_states, grads_raw, act_stats = \
+                _health.value_grad_with_stats(
+                    self._loss_fn, stats_cfg, params, states, xs, ys,
+                    masks, rng)
+            grads = _updaters.normalize_gradients(grads_raw, norm_kind,
+                                                  norm_thr)
             deltas, opt_state = updater.update(grads, opt_state, it)
             params = _updaters.apply_updates(params, deltas)
             kept = {name: {k: new_states[name].get(k, v)
                            for k, v in st_old.items()}
                     for name, st_old in states.items()}
+            if collect:
+                stats = _health.model_stats(params, grads_raw, deltas,
+                                            act_stats, stats_cfg, loss=loss)
+                return (params, opt_state, kept, it + 1), (loss, stats)
             return (params, opt_state, kept, it + 1), loss
 
         def scan_steps(params, opt_state, states, xs, ys, masks, it0):
-            (params, opt_state, states, _), losses = jax.lax.scan(
+            (params, opt_state, states, _), ys_out = jax.lax.scan(
                 one, (params, opt_state, states, it0), (xs, ys, masks),
                 unroll=_xla.scan_unroll())
-            return params, opt_state, states, losses
+            if collect:
+                losses, stats_seq = ys_out
+                last_stats = jax.tree_util.tree_map(lambda a: a[-1],
+                                                    stats_seq)
+                return params, opt_state, states, losses, last_stats
+            return params, opt_state, states, ys_out
 
         return jax.jit(scan_steps, donate_argnums=(0, 1),
                        compiler_options=_xla.train_step_options())
@@ -628,16 +687,26 @@ class ComputationGraph:
         if masks is not None:
             masks = [None if m is None else jnp.asarray(m)
                      for m in _as_list(masks)]
-        cache_key = f"train_scan@{_xla.trace_env_key()}"
+        cfg = self.health_stats
+        suffix = "" if cfg is None else f"|stats={cfg.trace_key()}"
+        cache_key = f"train_scan@{_xla.trace_env_key()}{suffix}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = _xla.retrace_guard(self._make_train_scan(),
-                                    "ComputationGraph.train_scan")
+            name = ("ComputationGraph.train_scan" if cfg is None
+                    else "ComputationGraph.train_scan_stats")
+            fn = _xla.retrace_guard(self._make_train_scan(cfg), name)
             self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
-        params, opt_state, new_states, losses = fn(
+        out = fn(
             self.params, self.updater_state, self._states_map(), xs, ys,
             masks, it0)
+        if cfg is not None:
+            params, opt_state, new_states, losses, stats = out
+            self._last_health_stats = _health.DeviceStats(
+                stats, iteration=self.iteration_count + k,
+                model="ComputationGraph")
+        else:
+            params, opt_state, new_states, losses = out
         self.params = params
         self.updater_state = opt_state
         self._update_count += k
@@ -654,38 +723,52 @@ class ComputationGraph:
             self.iteration_count += k
         return losses
 
-    def _make_train_repeat(self):
+    def _make_train_repeat(self, stats_cfg: Optional[_health.StatsConfig] = None):
         """K train steps on ONE closed-over batch via lax.scan over step
-        indices — constant HBM regardless of K. Used by fit_repeated()."""
+        indices — constant HBM regardless of K. Used by fit_repeated().
+        With ``stats_cfg`` the scan also emits the health-stats pytree of
+        the LAST step (same window semantics as fit_scan)."""
         t = self.training
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
         base = _rng.key(t.seed)
+        collect = stats_cfg is not None
 
         def one(xs, ys, masks, carry, it):
             params, opt_state, states = carry
             rng = jax.random.fold_in(base, it)
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(
-                    params, states, xs, ys, masks, rng)
-            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            loss, new_states, grads_raw, act_stats = \
+                _health.value_grad_with_stats(
+                    self._loss_fn, stats_cfg, params, states, xs, ys,
+                    masks, rng)
+            grads = _updaters.normalize_gradients(grads_raw, norm_kind,
+                                                  norm_thr)
             deltas, opt_state = updater.update(grads, opt_state, it)
             params = _updaters.apply_updates(params, deltas)
             kept = {name: {k: new_states[name].get(k, v)
                            for k, v in st_old.items()}
                     for name, st_old in states.items()}
+            if collect:
+                stats = _health.model_stats(params, grads_raw, deltas,
+                                            act_stats, stats_cfg, loss=loss)
+                return (params, opt_state, kept), (loss, stats)
             return (params, opt_state, kept), loss
 
         def repeat_steps(params, opt_state, states, xs, ys, masks, it0, k):
             # unroll (default 2): XLA removes inter-iteration carry copies
             # between the paired bodies (measured ~1.2 ms/step on ResNet-50
             # @ v5e); DL4JTPU_SCAN_UNROLL overrides for tuning
-            (params, opt_state, states), losses = jax.lax.scan(
+            (params, opt_state, states), ys_out = jax.lax.scan(
                 functools.partial(one, xs, ys, masks),
                 (params, opt_state, states), it0 + jnp.arange(k),
                 unroll=_xla.scan_unroll())
-            return params, opt_state, states, losses
+            if collect:
+                losses, stats_seq = ys_out
+                last_stats = jax.tree_util.tree_map(lambda a: a[-1],
+                                                    stats_seq)
+                return params, opt_state, states, losses, last_stats
+            return params, opt_state, states, ys_out
 
         return jax.jit(repeat_steps, donate_argnums=(0, 1, 2),
                        static_argnums=(7,),
@@ -703,16 +786,26 @@ class ComputationGraph:
         if masks is not None:
             masks = [None if m is None else jnp.asarray(m)
                      for m in _as_list(masks)]
-        cache_key = f"train_repeat@{_xla.trace_env_key()}"
+        cfg = self.health_stats
+        suffix = "" if cfg is None else f"|stats={cfg.trace_key()}"
+        cache_key = f"train_repeat@{_xla.trace_env_key()}{suffix}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = _xla.retrace_guard(self._make_train_repeat(),
-                                    "ComputationGraph.train_repeat")
+            name = ("ComputationGraph.train_repeat" if cfg is None
+                    else "ComputationGraph.train_repeat_stats")
+            fn = _xla.retrace_guard(self._make_train_repeat(cfg), name)
             self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
-        params, opt_state, new_states, losses = fn(
+        out = fn(
             self.params, self.updater_state, self._states_map(), inputs,
             labels, masks, it0, int(k))
+        if cfg is not None:
+            params, opt_state, new_states, losses, stats = out
+            self._last_health_stats = _health.DeviceStats(
+                stats, iteration=self.iteration_count + int(k),
+                model="ComputationGraph")
+        else:
+            params, opt_state, new_states, losses = out
         self.params = params
         self.updater_state = opt_state
         self._update_count += int(k)
@@ -823,9 +916,18 @@ class ComputationGraph:
         rng = _rng.fold_name(_rng.key(self.training.seed),
                              f"update_{self._update_count}")
         it = jnp.asarray(self._update_count, jnp.int32)
-        params, opt_state, new_states, loss = self._train_step()(
+        out = self._train_step()(
             self.params, self.updater_state, self._states_map(rnn_state),
             inputs, labels, masks, rng, it)
+        # sharded overrides always return 4 outputs; only the stats
+        # variant of the owned step returns the fifth (the stats pytree)
+        if len(out) == 5:
+            params, opt_state, new_states, loss, stats = out
+            self._last_health_stats = _health.DeviceStats(
+                stats, iteration=self.iteration_count + 1,
+                model="ComputationGraph")
+        else:
+            params, opt_state, new_states, loss = out
         self.params = params
         self.updater_state = opt_state
         self._update_count += 1

@@ -40,6 +40,7 @@ from .. import dtypes as _dtypes
 from .. import losses as _losses
 from .. import rng as _rng
 from ..optimize import updaters as _updaters
+from ..util import health as _health
 from ..util import xla as _xla
 from ..util.netutil import note_streamed_steps as _note_streamed_steps
 from ..util.netutil import precheck_streamed_steps as _precheck_streamed_steps
@@ -73,6 +74,11 @@ class MultiLayerNetwork:
         self._rnn_steps_fed = 0    # streaming steps since last cache reset
         self._updater = None
         self._jit_cache: Dict[str, Any] = {}
+        # on-device training-health stats (util.health): None = off (the
+        # default; the no-stats trace is untouched), a StatsConfig routes
+        # fit_batch/fit_scan through the stats-collecting step variant
+        self.health_stats: Optional[_health.StatsConfig] = None
+        self._last_health_stats: Optional[_health.DeviceStats] = None
 
         out = self.layers[-1]
         self._has_loss_output = hasattr(out, "compute_score_array")
@@ -336,14 +342,31 @@ class MultiLayerNetwork:
                     total = total + 0.5 * l2 * jnp.sum(jnp.square(w))
         return total
 
-    def _loss_fn(self, params, states, x, y, mask, rng):
+    def _loss_fn(self, params, states, x, y, mask, rng, *,
+                 collect_stats=False):
+        # collect_stats: falsy = plain loss; True or a health.StatsConfig
+        # (whose act_sample bounds the activation reductions) additionally
+        # returns per-layer activation summaries through the aux output
         if not self._has_loss_output:
             raise ValueError(
                 "final layer has no loss (need OutputLayer/RnnOutputLayer/"
                 "LossLayer to train with fit())")
-        hidden, new_states = self._forward(
+        n_hidden = len(self.layers) - 1
+        fwd = self._forward(
             params, states, x, train=True, rng=rng, mask=mask,
-            upto=len(self.layers) - 1)
+            upto=n_hidden, collect=collect_stats)
+        if collect_stats:
+            # collect=True keeps per-layer activations (bypassing remat —
+            # stats collection trades that memory saving for visibility);
+            # summarize each to 3 gradient-stopped scalars right here
+            acts, new_states = fwd
+            hidden = acts[-1]
+            sample = getattr(collect_stats, "act_sample", 0)
+            act_stats = {
+                _layer_key(i): _health.act_summary(acts[i + 1], sample)
+                for i in range(n_hidden)}
+        else:
+            hidden, new_states = fwd
         out_idx = len(self.layers) - 1
         out_layer = self.layers[out_idx]
         proc = self.conf.input_preprocessors.get(out_idx)
@@ -371,6 +394,8 @@ class MultiLayerNetwork:
         # float32 otherwise (bf16 losses are too coarse for LR-sized steps)
         loss_dtype = (jnp.float64 if self.policy.param_dtype == jnp.float64
                       else jnp.float32)
+        if collect_stats:
+            return loss.astype(loss_dtype), (new_states, act_stats)
         return loss.astype(loss_dtype), new_states
 
     def score_for(self, x, y, mask=None) -> float:
@@ -402,46 +427,65 @@ class MultiLayerNetwork:
     # the jitted train step
     # ------------------------------------------------------------------
 
-    def _make_train_step(self):
+    def _make_train_step(self, stats_cfg: Optional[_health.StatsConfig] = None):
         t = self.training
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
+        collect = stats_cfg is not None
 
         def step(params, opt_state, states, x, y, mask, rng, iteration):
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
-            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            loss, new_states, grads_raw, act_stats = \
+                _health.value_grad_with_stats(
+                    self._loss_fn, stats_cfg, params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads_raw, norm_kind,
+                                                  norm_thr)
             deltas, opt_state = updater.update(grads, opt_state, iteration)
             params = _updaters.apply_updates(params, deltas)
-            return params, opt_state, new_states, loss
+            if not collect:
+                return params, opt_state, new_states, loss
+            # per-layer health stats in the SAME dispatch: raw (pre-norm)
+            # grads, the applied deltas, and the post-update params
+            stats = _health.model_stats(params, grads_raw, deltas,
+                                        act_stats, stats_cfg, loss=loss)
+            return params, opt_state, new_states, loss, stats
 
         return jax.jit(step, donate_argnums=(0, 1),
                        compiler_options=_xla.train_step_options())
 
     def _train_step(self):
         # explicit override first (ParallelWrapper installs its sharded
-        # SPMD step here; an override is pinned, not trace-env-keyed)
+        # SPMD step here; an override is pinned, not trace-env-keyed and
+        # not stats-keyed — sharded steps do not collect health stats)
         fn = self._jit_cache.get("train_step_override")
         if fn is not None:
             return fn
-        cache_key = f"train_step@{_xla.trace_env_key()}"
+        cfg = self.health_stats
+        suffix = "" if cfg is None else f"|stats={cfg.trace_key()}"
+        cache_key = f"train_step@{_xla.trace_env_key()}{suffix}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = _xla.retrace_guard(self._make_train_step(),
-                                    "MultiLayerNetwork.train_step")
+            # distinct guard name for the stats variant: the no-stats
+            # trace's retrace pin (1 compile per signature) must not
+            # move when stats are toggled on and back off
+            name = ("MultiLayerNetwork.train_step" if cfg is None
+                    else "MultiLayerNetwork.train_step_stats")
+            fn = _xla.retrace_guard(self._make_train_step(cfg), name)
             self._jit_cache[cache_key] = fn
         return fn
 
-    def _make_train_scan(self):
+    def _make_train_scan(self, stats_cfg: Optional[_health.StatsConfig] = None):
         """K train steps fused into ONE XLA program via lax.scan — the
         idiomatic TPU inner loop: no per-step host dispatch, the whole
-        sequence of updates runs on-chip. Used by fit_scan()."""
+        sequence of updates runs on-chip. Used by fit_scan(). With
+        ``stats_cfg`` the scan also emits the health-stats pytree of the
+        LAST step (stats stay per-dispatch-window, like the score)."""
         t = self.training
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
         base = _rng.key(t.seed)
+        collect = stats_cfg is not None
 
         def one(carry, batch):
             params, opt_state, states, it = carry
@@ -450,9 +494,11 @@ class MultiLayerNetwork:
             # eagerly from the host-side update count bakes fresh constants
             # into the program and forces a recompile every call
             rng = jax.random.fold_in(base, it)
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
-            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            loss, new_states, grads_raw, act_stats = \
+                _health.value_grad_with_stats(
+                    self._loss_fn, stats_cfg, params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads_raw, norm_kind,
+                                                  norm_thr)
             deltas, opt_state = updater.update(grads, opt_state, it)
             params = _updaters.apply_updates(params, deltas)
             # carry structure must stay fixed: keep exactly the persistent
@@ -460,13 +506,22 @@ class MultiLayerNetwork:
             kept = [
                 {k: new_states[i].get(k, v) for k, v in st_old.items()}
                 for i, st_old in enumerate(states)]
+            if collect:
+                stats = _health.model_stats(params, grads_raw, deltas,
+                                            act_stats, stats_cfg, loss=loss)
+                return (params, opt_state, kept, it + 1), (loss, stats)
             return (params, opt_state, kept, it + 1), loss
 
         def scan_steps(params, opt_state, states, xs, ys, masks, it0):
-            (params, opt_state, states, _), losses = jax.lax.scan(
+            (params, opt_state, states, _), ys_out = jax.lax.scan(
                 one, (params, opt_state, states, it0), (xs, ys, masks),
                 unroll=_xla.scan_unroll())
-            return params, opt_state, states, losses
+            if collect:
+                losses, stats_seq = ys_out
+                last_stats = jax.tree_util.tree_map(lambda a: a[-1],
+                                                    stats_seq)
+                return params, opt_state, states, losses, last_stats
+            return params, opt_state, states, ys_out
 
         return jax.jit(scan_steps, donate_argnums=(0, 1),
                        compiler_options=_xla.train_step_options())
@@ -482,16 +537,26 @@ class MultiLayerNetwork:
         k = xs.shape[0]
         if masks is not None:
             masks = jnp.asarray(masks)
-        cache_key = f"train_scan@{_xla.trace_env_key()}"
+        cfg = self.health_stats
+        suffix = "" if cfg is None else f"|stats={cfg.trace_key()}"
+        cache_key = f"train_scan@{_xla.trace_env_key()}{suffix}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = _xla.retrace_guard(self._make_train_scan(),
-                                    "MultiLayerNetwork.train_scan")
+            name = ("MultiLayerNetwork.train_scan" if cfg is None
+                    else "MultiLayerNetwork.train_scan_stats")
+            fn = _xla.retrace_guard(self._make_train_scan(cfg), name)
             self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
         states = self._states_list()
-        params, opt_state, new_states, losses = fn(
+        out = fn(
             self.params, self.updater_state, states, xs, ys, masks, it0)
+        if cfg is not None:
+            params, opt_state, new_states, losses, stats = out
+            self._last_health_stats = _health.DeviceStats(
+                stats, iteration=self.iteration_count + k,
+                model="MultiLayerNetwork")
+        else:
+            params, opt_state, new_states, losses = out
         self.params = params
         self.updater_state = opt_state
         self._update_count += k
@@ -508,36 +573,50 @@ class MultiLayerNetwork:
             self.iteration_count += k
         return losses
 
-    def _make_train_repeat(self):
+    def _make_train_repeat(self, stats_cfg: Optional[_health.StatsConfig] = None):
         """K train steps on ONE closed-over batch via lax.scan over step
-        indices — constant HBM regardless of K. Used by fit_repeated()."""
+        indices — constant HBM regardless of K. Used by fit_repeated().
+        With ``stats_cfg`` the scan also emits the health-stats pytree of
+        the LAST step (same window semantics as fit_scan)."""
         t = self.training
         norm_kind = t.gradient_normalization
         norm_thr = float(t.gradient_normalization_threshold)
         updater = self._updater
         base = _rng.key(t.seed)
+        collect = stats_cfg is not None
 
         def one(x, y, mask, carry, it):
             params, opt_state, states = carry
             rng = jax.random.fold_in(base, it)
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, states, x, y, mask, rng)
-            grads = _updaters.normalize_gradients(grads, norm_kind, norm_thr)
+            loss, new_states, grads_raw, act_stats = \
+                _health.value_grad_with_stats(
+                    self._loss_fn, stats_cfg, params, states, x, y, mask, rng)
+            grads = _updaters.normalize_gradients(grads_raw, norm_kind,
+                                                  norm_thr)
             deltas, opt_state = updater.update(grads, opt_state, it)
             params = _updaters.apply_updates(params, deltas)
             kept = [
                 {k: new_states[i].get(k, v) for k, v in st_old.items()}
                 for i, st_old in enumerate(states)]
+            if collect:
+                stats = _health.model_stats(params, grads_raw, deltas,
+                                            act_stats, stats_cfg, loss=loss)
+                return (params, opt_state, kept), (loss, stats)
             return (params, opt_state, kept), loss
 
         def repeat_steps(params, opt_state, states, x, y, mask, it0, k):
             # unroll (default 2): XLA removes inter-iteration carry copies
             # between the paired bodies (measured ~1.2 ms/step on ResNet-50
             # @ v5e); DL4JTPU_SCAN_UNROLL overrides for tuning
-            (params, opt_state, states), losses = jax.lax.scan(
+            (params, opt_state, states), ys_out = jax.lax.scan(
                 functools.partial(one, x, y, mask), (params, opt_state, states),
                 it0 + jnp.arange(k), unroll=_xla.scan_unroll())
-            return params, opt_state, states, losses
+            if collect:
+                losses, stats_seq = ys_out
+                last_stats = jax.tree_util.tree_map(lambda a: a[-1],
+                                                    stats_seq)
+                return params, opt_state, states, losses, last_stats
+            return params, opt_state, states, ys_out
 
         return jax.jit(repeat_steps, donate_argnums=(0, 1, 2),
                        static_argnums=(7,),
@@ -553,16 +632,26 @@ class MultiLayerNetwork:
         self._reject_tbptt(x, "fit_repeated")
         if mask is not None:
             mask = jnp.asarray(mask)
-        cache_key = f"train_repeat@{_xla.trace_env_key()}"
+        cfg = self.health_stats
+        suffix = "" if cfg is None else f"|stats={cfg.trace_key()}"
+        cache_key = f"train_repeat@{_xla.trace_env_key()}{suffix}"
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = _xla.retrace_guard(self._make_train_repeat(),
-                                    "MultiLayerNetwork.train_repeat")
+            name = ("MultiLayerNetwork.train_repeat" if cfg is None
+                    else "MultiLayerNetwork.train_repeat_stats")
+            fn = _xla.retrace_guard(self._make_train_repeat(cfg), name)
             self._jit_cache[cache_key] = fn
         it0 = jnp.asarray(self._update_count, jnp.int32)
-        params, opt_state, new_states, losses = fn(
+        out = fn(
             self.params, self.updater_state, self._states_list(), x, y,
             mask, it0, int(k))
+        if cfg is not None:
+            params, opt_state, new_states, losses, stats = out
+            self._last_health_stats = _health.DeviceStats(
+                stats, iteration=self.iteration_count + int(k),
+                model="MultiLayerNetwork")
+        else:
+            params, opt_state, new_states, losses = out
         self.params = params
         self.updater_state = opt_state
         self._update_count += int(k)
@@ -590,6 +679,18 @@ class MultiLayerNetwork:
 
     def add_listener(self, listener) -> None:
         self.listeners.append(listener)
+
+    def enable_health_stats(self, config=True) -> None:
+        """Compute per-layer training-health stats (util.health) INSIDE
+        the train dispatch from the next fit call on: the stats-keyed jit
+        cache traces a separate program, so the cached no-stats trace is
+        untouched and toggling back off reuses it without a recompile.
+        Consumers read :func:`util.health.latest_stats` — one host sync
+        per read, the snapshot carries the step loss."""
+        self.health_stats = _health.StatsConfig.coerce(config)
+
+    def disable_health_stats(self) -> None:
+        self.health_stats = None
 
     def fit(self, data, labels=None, *, epochs: int = 1, mask=None,
             coalesce: Optional[int] = None, session=None) -> None:
@@ -685,8 +786,17 @@ class MultiLayerNetwork:
                              f"update_{self._update_count}")
         states = self._states_list(rnn_state)
         it = jnp.asarray(self._update_count, jnp.int32)
-        params, opt_state, new_states, loss = self._train_step()(
+        out = self._train_step()(
             self.params, self.updater_state, states, x, y, mask, rng, it)
+        # sharded overrides always return 4 outputs; only the stats
+        # variant of the owned step returns the fifth (the stats pytree)
+        if len(out) == 5:
+            params, opt_state, new_states, loss, stats = out
+            self._last_health_stats = _health.DeviceStats(
+                stats, iteration=self.iteration_count + 1,
+                model="MultiLayerNetwork")
+        else:
+            params, opt_state, new_states, loss = out
         self.params = params
         self.updater_state = opt_state
         self._update_count += 1

@@ -51,12 +51,42 @@ class TrainingListener:
     def on_backward_pass(self, model) -> None:
         pass
 
-    def on_step_skipped(self, model, iteration: int, reason: str) -> None:
+    def on_step_skipped(self, model, iteration: int, reason: str,
+                        info: Optional[dict] = None) -> None:
         """A training step was detected as divergent (e.g. non-finite
         gradients) and skipped — the params did not move this iteration.
         Fired by the resilience-guarded trainers (parallel wrapper /
-        sharded DSL trainers with ``skip_nonfinite_budget`` set)."""
+        sharded DSL trainers with ``skip_nonfinite_budget`` set).
+
+        ``info`` (when present) carries structured context: ``model``
+        (name), ``iteration``, and — when NaN layer-of-origin attribution
+        ran (``util.health.attribute_nonfinite``) — ``layer``,
+        ``quantity`` and ``param`` of the first offending value. Legacy
+        3-argument overrides keep working: the guards fire through
+        :func:`fire_step_skipped`, which degrades to the old signature."""
         pass
+
+
+def fire_step_skipped(listener, model, iteration: int, reason: str,
+                      info: Optional[dict] = None) -> None:
+    """Fire ``on_step_skipped`` with the structured ``info`` dict,
+    degrading to the legacy 3-argument signature for user listeners that
+    predate it — the one copy of the adaptive call the guards and
+    composite listeners share."""
+    hook = getattr(listener, "on_step_skipped", None)
+    if hook is None:
+        return
+    try:
+        import inspect
+        sig = inspect.signature(hook)
+        takes_info = any(p.name == "info" or p.kind == p.VAR_KEYWORD
+                         for p in sig.parameters.values())
+    except (TypeError, ValueError):
+        takes_info = False
+    if takes_info:
+        hook(model, iteration, reason, info=info)
+    else:
+        hook(model, iteration, reason)
 
 
 class ScoreIterationListener(TrainingListener):
@@ -163,10 +193,9 @@ class ComposableIterationListener(TrainingListener):
         for l in self.listeners:
             l.on_backward_pass(model)
 
-    def on_step_skipped(self, model, iteration, reason):
+    def on_step_skipped(self, model, iteration, reason, info=None):
         for l in self.listeners:
-            if hasattr(l, "on_step_skipped"):
-                l.on_step_skipped(model, iteration, reason)
+            fire_step_skipped(l, model, iteration, reason, info)
 
 
 class MetricsListener(TrainingListener):
@@ -201,7 +230,9 @@ class MetricsListener(TrainingListener):
             "training_epochs_total", "Training epochs completed", ("model",))
         self._skipped = reg.counter(
             "training_steps_skipped_total",
-            "Steps skipped by the non-finite guard", ("model",))
+            "Steps skipped by the non-finite guard; `layer` names the "
+            "attributed origin (empty when attribution did not run)",
+            ("model", "layer"))
         self._score = reg.gauge(
             "training_score", "Score at the latest iteration", ("model",))
         self._iter_time = reg.histogram(
@@ -222,8 +253,9 @@ class MetricsListener(TrainingListener):
     def on_epoch_end(self, model, epoch):
         self._epochs.inc(model=self.name)
 
-    def on_step_skipped(self, model, iteration, reason):
-        self._skipped.inc(model=self.name)
+    def on_step_skipped(self, model, iteration, reason, info=None):
+        layer = (info or {}).get("layer") or ""
+        self._skipped.inc(model=self.name, layer=layer)
 
 
 class ParamAndGradientIterationListener(TrainingListener):

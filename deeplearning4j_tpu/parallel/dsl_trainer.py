@@ -220,6 +220,8 @@ class ShardedDSLTrainerBase:
         net._persist_states(new_states)
         net._score = loss
         if ok is not None:
-            self.nonfinite_guard.step(ok)   # may raise once over budget
+            # may raise once over budget; the batch enables layer-of-origin
+            # attribution (net.params already holds the selected tree)
+            self.nonfinite_guard.step(ok, batch=(xs, ys, ms))
         net._fire_iteration(xs[0].shape[0], loss)
         return loss

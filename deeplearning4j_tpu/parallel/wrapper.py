@@ -175,7 +175,9 @@ class ParallelWrapper:
                 return out
             params, opt_state, new_states, loss, ok = out
             try:
-                guard.step(ok)
+                # the returned (selected) params are the valid tree — the
+                # inputs were donated; attribution replays against them
+                guard.step(ok, batch=(x, y, mask), params=params)
             except Exception:
                 # the caller assigns net state only after we return, but
                 # the inputs were donated — hand the (unchanged, freshly

@@ -23,6 +23,10 @@ Endpoints:
                   ``traceparent`` headers parent the request spans
                   (Dapper-style propagation) and every response carries
                   a ``traceparent`` back
+  GET  /debug/health
+                  training-health telemetry (util/health.py): latest
+                  rule report, stats snapshot, and NaN layer-of-origin
+                  attribution
   POST /profile?seconds=N
                   capture a jax.profiler device trace (XPlane) for N
                   seconds (default 1, max 300) into a fresh run
@@ -238,6 +242,12 @@ class InferenceServer:
                     # not 500 on one unserializable attribute
                     self._json(json.loads(
                         json.dumps(payload, default=repr)))
+                elif path == "/debug/health":
+                    # training-health telemetry: latest rule report +
+                    # stats snapshot + NaN attribution (util.health)
+                    from ..util import health as _health
+                    self._json(json.loads(
+                        json.dumps(_health.debug_payload(), default=repr)))
                 else:
                     self._json({"error": "not found"}, 404)
 

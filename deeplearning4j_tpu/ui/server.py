@@ -28,6 +28,9 @@ Endpoints:
                             ?trace_id= filter. Requests carrying a
                             ``traceparent`` header join the caller's
                             trace (one ui.request span, header echoed)
+  GET  /debug/health        training-health telemetry (util/health.py):
+                            latest rule report, stats snapshot, and NaN
+                            layer-of-origin attribution
   POST /profile?seconds=N   capture a jax.profiler device trace for N
                             seconds (409 while one is in progress) —
                             profile the TRAINING process the dashboard
@@ -311,6 +314,12 @@ class _Handler(BaseHTTPRequestHandler):
             payload = {"traces": _timeline.trace_summaries(
                 _tracing.TRACER, trace_id=tid)}
             self._json(json.loads(json.dumps(payload, default=repr)))
+        elif url.path == "/debug/health":
+            # training-health telemetry: latest rule report + stats
+            # snapshot + NaN layer-of-origin attribution (util.health)
+            from ..util import health as _health
+            self._json(json.loads(
+                json.dumps(_health.debug_payload(), default=repr)))
         elif url.path == "/api/sessions":
             self._json(st.list_session_ids())
         elif url.path == "/api/overview":

@@ -1,0 +1,941 @@
+"""Training-health telemetry: on-device per-layer stats, a health-rules
+engine, and NaN layer-of-origin attribution.
+
+Parity-plus: the reference's L7 observability surface (StatsListener →
+StatsStorage → UI) computes param/gradient/update statistics HOST-side —
+``ui/stats.py::StatsListener._param_stats`` device_gets every tensor to
+histogram it, reintroducing the per-step host syncs the async-dispatch
+work removed. Here the model-internals statistics are computed INSIDE the
+jitted train step (the same dispatch that applies the update):
+
+- :func:`model_stats` — the per-layer stats pytree the stats-enabled
+  train steps return: param/grad/update L2 norms, update:param ratio,
+  activation mean/std + zero-fraction (dead-ReLU), per-layer non-finite
+  gradient counts, and fixed-edge log-bucket histograms (edges are
+  compile-time constants, so the histogram adds no retrace and no
+  data-dependent shapes).
+- :class:`DeviceStats` — LazyScore-style wrapper: the pytree stays on
+  device until a consumer reads ``.value()``, which performs ONE
+  device→host transfer (counted in ``training_host_syncs_total``). The
+  step loss rides inside the pytree, so a listener window costs exactly
+  one sync — score included.
+- :class:`HealthEngine` + :func:`default_rules` — turns snapshots into
+  per-rule ok/warn/critical verdicts (vanishing/exploding gradients
+  across depth, dead units, update:param ratio band, loss-divergence
+  trend, non-finite gradients), published as
+  ``training_health_state{model,rule,layer}`` gauges,
+  ``model_stats_*{model,layer}`` gauges, and ``health_state`` flight
+  events on every transition.
+- :func:`attribute_nonfinite` — the NaN layer-of-origin protocol: when a
+  step is skipped for non-finite gradients, replay the failing batch
+  through per-layer finite checks (inputs → params → activations in
+  forward order → gradients in backward order, i.e. closest to the loss
+  first, since activation NaNs propagate forward and gradient NaNs
+  propagate backward) and name the first offending layer/param.
+- :func:`debug_payload` — the ``GET /debug/health`` body served by
+  UIServer and InferenceServer: latest rule report, latest stats
+  snapshot, latest attribution.
+
+Per-layer label cardinality is bounded by model DEPTH (layer keys /
+vertex names), never by width or vocab, so the metric families stay
+inside the exposition lint's series budget.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import flightrecorder as _flight
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+Pytree = Any
+
+# The model-wide entry in a stats pytree (total grad norm, non-finite
+# count, the step loss). Layer keys never collide with it: both runtimes
+# name layers "layer_N" / by vertex name.
+MODEL_KEY = "_model_"
+
+OK, WARN, CRITICAL = "ok", "warn", "critical"
+HEALTH_STATE_VALUES = {OK: 0.0, WARN: 1.0, CRITICAL: 2.0}
+_SEVERITY = {OK: 0, WARN: 1, CRITICAL: 2}
+
+# Fixed log10(|x|) bucket edges: [-12, 4] in 16 buckets, plus an
+# underflow bucket (zeros and |x| < 1e-12) and an overflow bucket
+# (|x| > 1e4 and non-finite values). Fixed edges — unlike numpy's
+# data-dependent min/max — make the histogram a pure reduction with a
+# static shape, so it compiles into the train step once.
+HIST_LOG_LO = -12.0
+HIST_LOG_HI = 4.0
+HIST_LOG_BUCKETS = 16
+HIST_LEN = HIST_LOG_BUCKETS + 2
+
+
+def histogram_edges() -> np.ndarray:
+    """The log10 bucket edges (host-side; for rendering/labels)."""
+    return np.linspace(HIST_LOG_LO, HIST_LOG_HI, HIST_LOG_BUCKETS + 1)
+
+
+# ----------------------------------------------------------------------
+# on-device reductions (called inside the jitted train step)
+# ----------------------------------------------------------------------
+
+def _inexact_leaves(tree: Pytree) -> List[Any]:
+    import jax
+    import jax.numpy as jnp
+    return [l for l in jax.tree_util.tree_leaves(tree)
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact)]
+
+
+def log_histogram(x) -> Any:
+    """int32[HIST_LEN] counts of |x| over the fixed log10 edges.
+    Bucket 0 = zeros/underflow; bucket HIST_LEN-1 = overflow AND
+    non-finite values (so a NaN-poisoned tensor is visible in the
+    histogram too, not just in the non-finite counter).
+
+    Implemented as HIST_LEN masked reductions over a bucket-index array
+    rather than a scatter-add: XLA lowers the scatter serially (~100ns/
+    element on CPU — it dominated the whole stats pass), while the
+    compare+sum loop fuses into one vectorized sweep."""
+    import jax.numpy as jnp
+    ax = jnp.abs(jnp.ravel(x).astype(jnp.float32))
+    finite = jnp.isfinite(ax)
+    step = (HIST_LOG_HI - HIST_LOG_LO) / HIST_LOG_BUCKETS
+    logs = jnp.log10(jnp.where(ax > 0, ax, 1.0))
+    idx = jnp.floor((logs - HIST_LOG_LO) / step).astype(jnp.int32) + 1
+    idx = jnp.clip(idx, 0, HIST_LEN - 1)
+    idx = jnp.where(ax > 0, idx, 0)
+    idx = jnp.where(finite, idx, HIST_LEN - 1)
+    return jnp.stack([jnp.sum((idx == b).astype(jnp.int32))
+                      for b in range(HIST_LEN)])
+
+
+def tree_l2(tree: Pytree) -> Any:
+    """float32 L2 norm over every inexact leaf of a pytree."""
+    import jax.numpy as jnp
+    leaves = _inexact_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    total = jnp.float32(0.0)
+    for l in leaves:
+        total = total + jnp.sum(jnp.square(l.astype(jnp.float32)))
+    return jnp.sqrt(total)
+
+
+def tree_nonfinite_count(tree: Pytree) -> Any:
+    """int32 count of non-finite elements across a pytree."""
+    import jax.numpy as jnp
+    leaves = _inexact_leaves(tree)
+    if not leaves:
+        return jnp.int32(0)
+    total = jnp.int32(0)
+    for l in leaves:
+        total = total + jnp.sum(~jnp.isfinite(l)).astype(jnp.int32)
+    return total
+
+
+def tree_histogram(tree: Pytree) -> Any:
+    """Summed :func:`log_histogram` over every inexact leaf."""
+    import jax.numpy as jnp
+    leaves = _inexact_leaves(tree)
+    if not leaves:
+        return jnp.zeros(HIST_LEN, jnp.int32)
+    counts = jnp.zeros(HIST_LEN, jnp.int32)
+    for l in leaves:
+        counts = counts + log_histogram(l)
+    return counts
+
+
+def act_summary(a, sample: int = 0) -> Dict[str, Any]:
+    """Per-layer activation scalars, gradient-stopped so collecting them
+    cannot perturb the backward pass. Two-moment std (E[x²]−E[x]²) keeps
+    it at two sweeps over the activation instead of jnp.std's
+    mean-subtract-square re-read. ``sample`` > 0 reduces over only the
+    first ``sample`` batch rows — the health rules need estimates, not
+    exact moments, and a 64-example sample keeps the reductions off the
+    critical path at large batch (the slice is static, so no retrace)."""
+    import jax
+    import jax.numpy as jnp
+    af = jax.lax.stop_gradient(a)
+    if sample and hasattr(af, "shape") and af.ndim >= 1 \
+            and af.shape[0] > sample:
+        af = af[:sample]
+    af = af.astype(jnp.float32)
+    m = jnp.mean(af)
+    m2 = jnp.mean(jnp.square(af))
+    return {"act_mean": m,
+            "act_std": jnp.sqrt(jnp.maximum(m2 - jnp.square(m), 0.0)),
+            "act_zero_frac": jnp.mean((af == 0.0).astype(jnp.float32))}
+
+
+@dataclasses.dataclass(frozen=True)
+class StatsConfig:
+    """What the stats-enabled train step collects. Part of the jit cache
+    key (``trace_key``), so flipping a field retraces under the new
+    collection set without touching the cached no-stats trace.
+    ``act_sample`` bounds the batch rows the activation moments reduce
+    over (0 = all rows)."""
+
+    histograms: bool = True
+    activations: bool = True
+    act_sample: int = 64
+
+    def trace_key(self) -> str:
+        return (f"h{int(self.histograms)}a{int(self.activations)}"
+                f"s{int(self.act_sample)}")
+
+    @staticmethod
+    def coerce(value) -> Optional["StatsConfig"]:
+        if value is None or value is False:
+            return None
+        if value is True:
+            return StatsConfig()
+        if isinstance(value, StatsConfig):
+            return value
+        raise TypeError(
+            f"health stats config must be True/False/None/StatsConfig, "
+            f"got {type(value).__name__}")
+
+
+def value_grad_with_stats(loss_fn, config: Optional[StatsConfig],
+                          params, *args):
+    """``jax.value_and_grad`` over a runtime ``_loss_fn``, optionally in
+    stats-collecting mode — the ONE copy of the collect/aux-unpack dance
+    every train-step/scan/repeat body in both runtimes shares. Returns
+    ``(loss, new_states, grads_raw, act_stats)`` with ``act_stats`` None
+    when ``config`` is None (grads are RAW, pre-normalization — what
+    :func:`model_stats` must see)."""
+    import jax
+    if config is not None:
+        fn = functools.partial(loss_fn, collect_stats=config)
+        (loss, (new_states, act_stats)), grads = jax.value_and_grad(
+            fn, has_aux=True)(params, *args)
+    else:
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, *args)
+        act_stats = None
+    return loss, new_states, grads, act_stats
+
+
+def model_stats(params: Dict[str, Pytree], grads: Dict[str, Pytree],
+                deltas: Dict[str, Pytree],
+                act_stats: Optional[Dict[str, Dict[str, Any]]],
+                config: StatsConfig, *, loss=None) -> Dict[str, Dict]:
+    """The per-layer stats pytree, computed INSIDE the jitted train step.
+
+    ``params``/``grads``/``deltas`` are the runtimes' layer-keyed trees
+    (params post-update — what you would checkpoint; grads RAW, before
+    normalization — what the health rules must see); ``act_stats`` maps
+    layer key → :func:`act_summary` output collected during the forward.
+    Everything reduces to scalars (plus the fixed-width histograms), so
+    the whole pytree is a few KB however wide the model is.
+    """
+    import jax.numpy as jnp
+    tiny = jnp.float32(1e-12)
+    out: Dict[str, Dict] = {}
+    for name in params:
+        entry: Dict[str, Any] = {}
+        if _inexact_leaves(params[name]):
+            pn = tree_l2(params[name])
+            un = tree_l2(deltas[name])
+            entry.update(
+                param_norm=pn,
+                grad_norm=tree_l2(grads[name]),
+                update_norm=un,
+                update_ratio=un / jnp.maximum(pn, tiny),
+                grad_nonfinite=tree_nonfinite_count(grads[name]))
+            if config.histograms:
+                entry["param_hist"] = tree_histogram(params[name])
+                entry["update_hist"] = tree_histogram(deltas[name])
+        acts = None if act_stats is None else act_stats.get(name)
+        if acts and config.activations:
+            entry.update(acts)
+        if entry:
+            out[name] = entry
+    model_entry: Dict[str, Any] = {
+        "grad_norm": tree_l2(grads),
+        "grad_nonfinite": tree_nonfinite_count(grads)}
+    if loss is not None:
+        model_entry["loss"] = jnp.asarray(loss, jnp.float32)
+    out[MODEL_KEY] = model_entry
+    return out
+
+
+# ----------------------------------------------------------------------
+# host-side consumption
+# ----------------------------------------------------------------------
+
+def to_jsonable(tree):
+    """Host snapshot → plain python (floats/ints/lists), JSON-ready."""
+    if isinstance(tree, dict):
+        return {k: to_jsonable(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [to_jsonable(v) for v in tree]
+    if isinstance(tree, np.ndarray):
+        return tree.item() if tree.ndim == 0 else tree.tolist()
+    if isinstance(tree, (np.floating, np.integer, np.bool_)):
+        return tree.item()
+    if hasattr(tree, "shape"):      # a stray device array
+        arr = np.asarray(tree)
+        return arr.item() if arr.ndim == 0 else arr.tolist()
+    return tree
+
+
+class DeviceStats:
+    """A stats pytree that stays on device until read (the LazyScore of
+    model internals). ``value()`` performs the single device→host
+    transfer — counted into ``training_host_syncs_total`` — and caches
+    the JSON-ready result, so a listener window costs exactly one sync
+    however many consumers read the same snapshot."""
+
+    __slots__ = ("_tree", "_host", "iteration", "model", "_registry")
+
+    def __init__(self, tree: Pytree, *, iteration: int = 0,
+                 model: str = "net", registry=None):
+        self._tree = tree
+        self._host: Optional[Dict] = None
+        self.iteration = int(iteration)
+        self.model = model
+        self._registry = registry
+
+    @property
+    def resolved(self) -> bool:
+        return self._host is not None
+
+    def value(self) -> Dict[str, Dict]:
+        if self._host is None:
+            import jax
+            from . import ingest as _ingest
+            _ingest.sync_counter(self._registry).inc()
+            tree, self._tree = self._tree, None
+            self._host = to_jsonable(jax.device_get(tree))
+        return self._host
+
+    def __repr__(self) -> str:
+        return (f"DeviceStats(iteration={self.iteration}, "
+                f"{'resolved' if self.resolved else '<on device>'})")
+
+
+def latest_stats(net) -> Optional[DeviceStats]:
+    """The most recent :class:`DeviceStats` a stats-enabled train step
+    stored on the net (None when stats are off or nothing ran yet)."""
+    return getattr(net, "_last_health_stats", None)
+
+
+def layer_items(stats: Dict[str, Dict]):
+    """(layer, entry) pairs excluding the model-wide entry, in depth
+    order (dict insertion order = the runtimes' layer order)."""
+    return [(k, v) for k, v in stats.items() if k != MODEL_KEY]
+
+
+# ----------------------------------------------------------------------
+# health rules
+# ----------------------------------------------------------------------
+
+class HealthSample(NamedTuple):
+    """What a rule sees: the host stats snapshot, the iteration it was
+    collected at, and the recent loss history (oldest first)."""
+    stats: Dict[str, Dict]
+    iteration: int
+    losses: Tuple[float, ...]
+
+
+class Verdict(NamedTuple):
+    layer: str
+    state: str
+    detail: str
+
+
+class HealthRule:
+    """One diagnosis. ``evaluate`` returns a verdict per layer it judged
+    (OK verdicts included, so the engine can record recoveries); an empty
+    list means the rule had nothing to judge this sample."""
+
+    name = "rule"
+
+    def evaluate(self, sample: HealthSample) -> List[Verdict]:
+        raise NotImplementedError
+
+
+def _finite(v) -> bool:
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+class UpdateRatioRule(HealthRule):
+    """update:param L2 ratio per layer. The classic LR-health band is
+    ~[1e-4, 1e-2] (DL4J's visualization guide); outside it the layer is
+    either frozen (too low) or thrashing (too high). Warmup iterations
+    are skipped — the first Adam steps legitimately overshoot the band
+    while the moment estimates bootstrap."""
+
+    name = "update_ratio"
+
+    def __init__(self, lo: float = 1e-4, hi: float = 1e-2,
+                 critical_factor: float = 10.0, warmup: int = 10):
+        self.lo, self.hi = float(lo), float(hi)
+        self.critical_factor = float(critical_factor)
+        self.warmup = int(warmup)
+
+    def evaluate(self, sample: HealthSample) -> List[Verdict]:
+        if sample.iteration < self.warmup:
+            return []
+        out = []
+        for layer, e in layer_items(sample.stats):
+            r, pn = e.get("update_ratio"), e.get("param_norm")
+            if r is None or not pn:
+                continue
+            if not _finite(r) or r > self.hi * self.critical_factor:
+                out.append(Verdict(layer, CRITICAL,
+                                   f"update:param ratio {r:.3e} far above "
+                                   f"the healthy band [{self.lo:g}, "
+                                   f"{self.hi:g}]"))
+            elif r < self.lo / self.critical_factor:
+                out.append(Verdict(layer, WARN,
+                                   f"update:param ratio {r:.3e} ~zero — "
+                                   "layer effectively frozen"))
+            elif r < self.lo or r > self.hi:
+                out.append(Verdict(layer, WARN,
+                                   f"update:param ratio {r:.3e} outside "
+                                   f"[{self.lo:g}, {self.hi:g}]"))
+            else:
+                out.append(Verdict(layer, OK, ""))
+        return out
+
+
+class ExplodingGradientsRule(HealthRule):
+    """Absolute per-layer gradient-norm blowup (an exploding run crosses
+    these within a few steps; the depth RATIO is the vanishing rule's
+    job)."""
+
+    name = "exploding_gradients"
+
+    def __init__(self, warn_norm: float = 1e3, critical_norm: float = 1e6):
+        self.warn_norm = float(warn_norm)
+        self.critical_norm = float(critical_norm)
+
+    def evaluate(self, sample: HealthSample) -> List[Verdict]:
+        out = []
+        for layer, e in layer_items(sample.stats):
+            gn = e.get("grad_norm")
+            if gn is None:
+                continue
+            if not _finite(gn) or gn > self.critical_norm:
+                out.append(Verdict(layer, CRITICAL,
+                                   f"gradient norm {gn:.3e} exploding"))
+            elif gn > self.warn_norm:
+                out.append(Verdict(layer, WARN,
+                                   f"gradient norm {gn:.3e} > "
+                                   f"{self.warn_norm:g}"))
+            else:
+                out.append(Verdict(layer, OK, ""))
+        return out
+
+
+class VanishingGradientsRule(HealthRule):
+    """Gradient attenuation ACROSS DEPTH: the ratio of the first param
+    layer's grad norm to the last's. A healthy deep net keeps it within
+    a few orders of magnitude; 1e-6 means the early layers see no
+    learning signal."""
+
+    name = "vanishing_gradients"
+
+    def __init__(self, warn_ratio: float = 1e-6,
+                 critical_ratio: float = 1e-9):
+        self.warn_ratio = float(warn_ratio)
+        self.critical_ratio = float(critical_ratio)
+
+    def evaluate(self, sample: HealthSample) -> List[Verdict]:
+        layered = [(k, e) for k, e in layer_items(sample.stats)
+                   if _finite(e.get("grad_norm"))]
+        if len(layered) < 2:
+            return []
+        first_layer, first = layered[0]
+        last = layered[-1][1]
+        if not last["grad_norm"]:
+            return []
+        ratio = first["grad_norm"] / last["grad_norm"]
+        if ratio < self.critical_ratio:
+            state, detail = CRITICAL, (
+                f"first/last grad-norm ratio {ratio:.3e} — early layers "
+                "receive no gradient")
+        elif ratio < self.warn_ratio:
+            state, detail = WARN, (
+                f"first/last grad-norm ratio {ratio:.3e} < "
+                f"{self.warn_ratio:g}")
+        else:
+            state, detail = OK, ""
+        return [Verdict(first_layer, state, detail)]
+
+
+class DeadUnitsRule(HealthRule):
+    """Dead-unit (zero-activation) fraction per layer — the dead-ReLU
+    detector. Judged only on layers that carried activation stats."""
+
+    name = "dead_units"
+
+    def __init__(self, warn_frac: float = 0.9, critical_frac: float = 0.99):
+        self.warn_frac = float(warn_frac)
+        self.critical_frac = float(critical_frac)
+
+    def evaluate(self, sample: HealthSample) -> List[Verdict]:
+        out = []
+        for layer, e in layer_items(sample.stats):
+            zf = e.get("act_zero_frac")
+            if zf is None:
+                continue
+            if zf >= self.critical_frac:
+                out.append(Verdict(layer, CRITICAL,
+                                   f"{zf:.1%} of activations are exactly "
+                                   "zero — layer is dead"))
+            elif zf >= self.warn_frac:
+                out.append(Verdict(layer, WARN,
+                                   f"{zf:.1%} of activations are exactly "
+                                   "zero"))
+            else:
+                out.append(Verdict(layer, OK, ""))
+        return out
+
+
+class NonFiniteGradientsRule(HealthRule):
+    """Any non-finite gradient element is CRITICAL on its layer — the
+    stats-plane twin of the NonFiniteGuard skip path."""
+
+    name = "nonfinite_grads"
+
+    def evaluate(self, sample: HealthSample) -> List[Verdict]:
+        out = []
+        for layer, e in layer_items(sample.stats):
+            n = e.get("grad_nonfinite")
+            if n is None:
+                continue
+            if n:
+                out.append(Verdict(layer, CRITICAL,
+                                   f"{int(n)} non-finite gradient "
+                                   "elements"))
+            else:
+                out.append(Verdict(layer, OK, ""))
+        return out
+
+
+class LossDivergenceRule(HealthRule):
+    """Loss-trend divergence over the engine's loss window: a non-finite
+    loss is CRITICAL; a sustained rise (median of the newest samples vs
+    the oldest) is WARN/CRITICAL by factor."""
+
+    name = "loss_divergence"
+
+    def __init__(self, window: int = 6, warn_factor: float = 4.0,
+                 critical_factor: float = 100.0):
+        self.window = int(window)
+        self.warn_factor = float(warn_factor)
+        self.critical_factor = float(critical_factor)
+
+    def evaluate(self, sample: HealthSample) -> List[Verdict]:
+        losses = sample.losses
+        if not losses:
+            return []
+        if not math.isfinite(losses[-1]):
+            return [Verdict(MODEL_KEY, CRITICAL,
+                            f"loss is non-finite ({losses[-1]})")]
+        if len(losses) < self.window:
+            return [Verdict(MODEL_KEY, OK, "")]
+        head = sorted(losses[:3])[1]    # median of oldest 3
+        tail = sorted(losses[-3:])[1]   # median of newest 3
+        if head > 0 and tail > head * self.critical_factor:
+            return [Verdict(MODEL_KEY, CRITICAL,
+                            f"loss rose {tail / head:.1f}x over the "
+                            f"window ({head:.3e} -> {tail:.3e})")]
+        if head > 0 and tail > head * self.warn_factor:
+            return [Verdict(MODEL_KEY, WARN,
+                            f"loss rose {tail / head:.1f}x over the "
+                            f"window ({head:.3e} -> {tail:.3e})")]
+        return [Verdict(MODEL_KEY, OK, "")]
+
+
+def default_rules() -> List[HealthRule]:
+    return [UpdateRatioRule(), ExplodingGradientsRule(),
+            VanishingGradientsRule(), DeadUnitsRule(),
+            NonFiniteGradientsRule(), LossDivergenceRule()]
+
+
+# the stat fields mirrored into /metrics gauges (model_stats_* families)
+_STAT_GAUGE_FIELDS = ("param_norm", "grad_norm", "update_ratio",
+                      "act_zero_frac")
+
+
+class HealthEngine:
+    """Evaluates rules over stats snapshots; publishes gauges + flight
+    events; keeps the latest report for ``GET /debug/health``.
+
+    State machine: per (rule, layer), any change of verdict state records
+    a ``health_state`` flight event (ok→warn escalations AND recoveries),
+    so a post-mortem flight dump shows when each diagnosis flipped.
+    """
+
+    def __init__(self, rules: Optional[Sequence[HealthRule]] = None, *,
+                 model: str = "net", registry=None, loss_window: int = 16,
+                 publish_stats_gauges: bool = True):
+        from . import metrics as _metrics
+        reg = registry if registry is not None else _metrics.REGISTRY
+        self.model = model
+        self.rules = list(rules) if rules is not None else default_rules()
+        self._state_gauge = reg.gauge(
+            "training_health_state",
+            "Health-rule verdict per rule and layer (0=ok, 1=warn, "
+            "2=critical); layer label cardinality is bounded by model "
+            "depth", ("model", "rule", "layer"))
+        self._stat_gauges = None
+        if publish_stats_gauges:
+            self._stat_gauges = {
+                "param_norm": reg.gauge(
+                    "model_stats_param_norm",
+                    "Per-layer parameter L2 norm from the on-device "
+                    "stats pass", ("model", "layer")),
+                "grad_norm": reg.gauge(
+                    "model_stats_grad_norm",
+                    "Per-layer raw-gradient L2 norm from the on-device "
+                    "stats pass", ("model", "layer")),
+                "update_ratio": reg.gauge(
+                    "model_stats_update_ratio",
+                    "Per-layer update:param L2 ratio from the on-device "
+                    "stats pass", ("model", "layer")),
+                "act_zero_frac": reg.gauge(
+                    "model_stats_act_zero_frac",
+                    "Per-layer zero-activation fraction (dead units) "
+                    "from the on-device stats pass", ("model", "layer")),
+            }
+        self._losses: collections.deque = collections.deque(
+            maxlen=max(2, int(loss_window)))
+        self._states: Dict[Tuple[str, str], str] = {}
+        self.last_report: Optional[Dict] = None
+
+    def observe(self, stats: Dict[str, Dict], *,
+                iteration: int = 0) -> Dict:
+        """Feed one host snapshot (``DeviceStats.value()`` output).
+        Returns the rule report and remembers it for /debug/health."""
+        model_entry = stats.get(MODEL_KEY) or {}
+        loss = model_entry.get("loss")
+        if loss is not None:
+            self._losses.append(float(loss))
+        sample = HealthSample(stats=stats, iteration=int(iteration),
+                              losses=tuple(self._losses))
+        report_rules: Dict[str, Dict] = {}
+        worst_overall = OK
+        for rule in self.rules:
+            try:
+                verdicts = rule.evaluate(sample)
+            except Exception:
+                logger.exception("health rule %s failed", rule.name)
+                continue
+            if not verdicts:
+                continue
+            worst = OK
+            flagged: Dict[str, Dict] = {}
+            for v in verdicts:
+                self._state_gauge.set(HEALTH_STATE_VALUES[v.state],
+                                      model=self.model, rule=rule.name,
+                                      layer=v.layer)
+                key = (rule.name, v.layer)
+                prev = self._states.get(key, OK)
+                if v.state != prev:
+                    _flight.record(
+                        "health_state", model=self.model, rule=rule.name,
+                        layer=v.layer, from_state=prev, to_state=v.state,
+                        detail=v.detail, iteration=int(iteration))
+                    if _SEVERITY[v.state] > _SEVERITY[prev]:
+                        logger.warning(
+                            "health rule %s %s on %s/%s: %s", rule.name,
+                            v.state.upper(), self.model, v.layer, v.detail)
+                self._states[key] = v.state
+                if _SEVERITY[v.state] > _SEVERITY[worst]:
+                    worst = v.state
+                if v.state != OK:
+                    flagged[v.layer] = {"state": v.state,
+                                        "detail": v.detail}
+            report_rules[rule.name] = {
+                "state": worst, "layers": flagged,
+                "evaluated": len(verdicts)}
+            if _SEVERITY[worst] > _SEVERITY[worst_overall]:
+                worst_overall = worst
+        if self._stat_gauges is not None:
+            for layer, e in layer_items(stats):
+                for field, gauge in self._stat_gauges.items():
+                    v = e.get(field)
+                    if v is not None and _finite(v):
+                        gauge.set(v, model=self.model, layer=layer)
+        report = {"model": self.model, "iteration": int(iteration),
+                  "state": worst_overall, "rules": report_rules,
+                  "t": time.time()}
+        self.last_report = report
+        _remember_report(report, stats)
+        return report
+
+
+class HealthListener:
+    """Training listener consuming the on-device stats every ``frequency``
+    iterations: ONE host sync per window (the snapshot carries the loss,
+    so the LazyScore is never read). Enables the stats pass on the model
+    at attach time unless ``enable=False`` (then it only consumes stats
+    someone else enabled). Duck-typed against the TrainingListener
+    contract, like every listener the fit loop fires."""
+
+    def __init__(self, frequency: int = 10,
+                 engine: Optional[HealthEngine] = None,
+                 model: str = "net", registry=None, config=True,
+                 enable: bool = True):
+        self.frequency = max(1, int(frequency))
+        self.engine = (engine if engine is not None
+                       else HealthEngine(model=model, registry=registry))
+        self._config = StatsConfig.coerce(config) or StatsConfig()
+        self._enable = enable
+        self._last_observed = 0    # iteration of the last observed snapshot
+
+    def _ensure_enabled(self, model) -> None:
+        if (self._enable and getattr(model, "health_stats", None) is None
+                and hasattr(model, "enable_health_stats")):
+            model.enable_health_stats(self._config)
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        self._ensure_enabled(model)
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+    def on_step_skipped(self, model, iteration, reason, info=None) -> None:
+        pass
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        self._ensure_enabled(model)
+        ds = latest_stats(model)
+        # only observe a snapshot THIS iteration's dispatch produced:
+        # fit_scan/fit_repeated fire listeners for window-interior
+        # iterations whose snapshot belongs to the window's LAST step,
+        # and a model whose stats stopped (disable, or a step variant
+        # without them) would otherwise republish the frozen snapshot as
+        # live data — same staleness guard as StatsListener's device path
+        if ds is None or ds.iteration != iteration:
+            return
+        # cadence: exact frequency multiples on the per-step path, and
+        # "at least frequency iterations since the last observation" so
+        # scanned windows whose final iterations never align with the
+        # frequency (k=16 @ frequency=10 → finals 16, 32, ...) still get
+        # judged about every `frequency` iterations instead of only at
+        # lcm(frequency, k)
+        if (iteration % self.frequency
+                and iteration - self._last_observed < self.frequency):
+            return
+        self._last_observed = iteration
+        self.engine.observe(ds.value(), iteration=iteration)
+
+
+# ----------------------------------------------------------------------
+# NaN layer-of-origin attribution
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AttributionReport:
+    """Which layer a non-finite step originated at, and in what quantity
+    (``input`` → ``param`` → ``activation`` → ``gradient`` — the order
+    the protocol checks them in)."""
+
+    model: str
+    iteration: int
+    quantity: str                       # input|param|activation|gradient|unknown
+    layer: Optional[str] = None
+    param: Optional[str] = None
+    detail: str = ""
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def summary(self) -> str:
+        if self.layer is None:
+            return f"first non-finite quantity: {self.quantity}"
+        p = f".{self.param}" if self.param else ""
+        return f"first non-finite {self.quantity} at {self.layer}{p}"
+
+
+def _np_all_finite(a) -> bool:
+    arr = np.asarray(a)
+    if not np.issubdtype(arr.dtype, np.floating) \
+            and not np.issubdtype(arr.dtype, np.complexfloating):
+        return True
+    return bool(np.isfinite(arr).all())
+
+
+def _first_bad_param(tree) -> Optional[str]:
+    if not isinstance(tree, dict):
+        return None if _np_all_finite(tree) else ""
+    for pname, leaf in tree.items():
+        if hasattr(leaf, "dtype") and not _np_all_finite(leaf):
+            return pname
+    return None
+
+
+def attribute_nonfinite(net, x, y=None, mask=None, *, params=None,
+                        model: Optional[str] = None, iteration: int = 0,
+                        record: bool = True) -> AttributionReport:
+    """Replay a failing batch through per-layer finite checks and name the
+    first offending layer/param.
+
+    Protocol (each stage only runs if the previous found nothing):
+
+    1. **inputs** — a poisoned batch is the most common culprit.
+    2. **params**, forward order — a previously-corrupted checkpoint.
+    3. **activations**, forward order (eval-mode diagnostic forward):
+       activation NaNs propagate FORWARD, so the first non-finite layer
+       output is the origin.
+    4. **gradients**, BACKWARD order (one un-jitted ``jax.grad`` of the
+       training loss): gradient NaNs propagate from the loss toward the
+       input, so the origin is the non-finite layer CLOSEST to the loss.
+
+    This is a failure path: it runs un-jitted, on demand, never in the
+    hot loop. The report lands in the flight recorder and the
+    ``/debug/health`` payload (``record=False`` to suppress)."""
+    import jax
+    import jax.numpy as jnp
+    from .netutil import is_graph as _is_graph
+
+    graph = _is_graph(net)
+    params = params if params is not None else net.params
+    model = model or type(net).__name__
+    if graph:
+        order = list(net.topo_order)
+    else:
+        order = [f"layer_{i}" for i in range(len(net.layers))]
+
+    def _finish(quantity, layer=None, param=None, detail=""):
+        report = AttributionReport(model=model, iteration=int(iteration),
+                                   quantity=quantity, layer=layer,
+                                   param=param, detail=detail)
+        if record:
+            _flight.record("nonfinite_attribution", model=model,
+                           iteration=int(iteration), quantity=quantity,
+                           layer=layer, param=param, detail=detail)
+            _remember_attribution(report)
+        return report
+
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    if not graph:
+        # sharded-trainer callers hand list-wrapped batches either way;
+        # the sequential runtime's loss takes bare arrays
+        if isinstance(y, (list, tuple)):
+            y = y[0] if y else None
+        if isinstance(mask, (list, tuple)):
+            mask = mask[0] if mask else None
+    for i, a in enumerate(xs):
+        if a is not None and hasattr(a, "dtype") and not _np_all_finite(a):
+            return _finish("input", detail=f"network input {i} carries "
+                           "non-finite values")
+
+    for name in order:
+        bad = _first_bad_param(params.get(name) or {})
+        if bad is not None:
+            return _finish("param", layer=name, param=bad or None)
+
+    # eval-mode diagnostic forward (deterministic: no dropout draws); a
+    # train-mode-only NaN source then falls through to the gradient stage
+    try:
+        if graph:
+            inputs = [jnp.asarray(a) for a in xs]
+            acts, _ = net._forward(params, net._states_map(), inputs,
+                                   train=False)
+            per_layer = [(name, acts[name]) for name in order]
+        else:
+            acts, _ = net._forward(params, net._states_list(),
+                                   jnp.asarray(xs[0]), train=False,
+                                   collect=True)
+            per_layer = [(order[i], acts[i + 1])
+                         for i in range(len(acts) - 1)]
+        for name, a in per_layer:
+            if not _np_all_finite(a):
+                return _finish("activation", layer=name)
+    except Exception as e:
+        logger.warning("attribution forward replay failed: %s", e)
+
+    if y is not None:
+        try:
+            if graph:
+                ys = [jnp.asarray(a) for a in
+                      (y if isinstance(y, (list, tuple)) else [y])]
+                ms = (None if mask is None else
+                      [None if m is None else jnp.asarray(m) for m in
+                       (mask if isinstance(mask, (list, tuple))
+                        else [mask])])
+                inputs = [jnp.asarray(a) for a in xs]
+                grads = jax.grad(lambda p: net._loss_fn(
+                    p, net._states_map(), inputs, ys, ms, None)[0])(params)
+            else:
+                grads = jax.grad(lambda p: net._loss_fn(
+                    p, net._states_list(), jnp.asarray(xs[0]),
+                    jnp.asarray(y),
+                    None if mask is None else jnp.asarray(mask),
+                    None)[0])(params)
+            for name in reversed(order):
+                bad = _first_bad_param(grads.get(name) or {})
+                if bad is not None:
+                    return _finish("gradient", layer=name,
+                                   param=bad or None)
+        except Exception as e:
+            logger.warning("attribution gradient replay failed: %s", e)
+
+    return _finish("unknown", detail="replay found every checked "
+                   "quantity finite (transient, or a train-mode-only "
+                   "source)")
+
+
+# ----------------------------------------------------------------------
+# /debug/health state
+# ----------------------------------------------------------------------
+
+_debug_lock = threading.Lock()
+_last_report: Optional[Dict] = None
+_last_stats: Optional[Dict] = None
+_last_attribution: Optional[AttributionReport] = None
+
+
+def _remember_report(report: Dict, stats: Dict) -> None:
+    global _last_report, _last_stats
+    with _debug_lock:
+        _last_report = report
+        _last_stats = stats
+
+
+def _remember_attribution(report: AttributionReport) -> None:
+    global _last_attribution
+    with _debug_lock:
+        _last_attribution = report
+
+
+def last_attribution() -> Optional[AttributionReport]:
+    with _debug_lock:
+        return _last_attribution
+
+
+def reset_debug_state() -> None:
+    """Test hook: forget the remembered report/stats/attribution."""
+    global _last_report, _last_stats, _last_attribution
+    with _debug_lock:
+        _last_report = _last_stats = _last_attribution = None
+
+
+def debug_payload() -> Dict:
+    """The ``GET /debug/health`` body: latest rule report, latest stats
+    snapshot, latest NaN attribution (each None until produced)."""
+    with _debug_lock:
+        return {
+            "report": _last_report,
+            "stats": _last_stats,
+            "attribution": (_last_attribution.to_dict()
+                            if _last_attribution is not None else None),
+            "histogram_log10_edges": histogram_edges().tolist(),
+        }

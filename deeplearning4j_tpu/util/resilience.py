@@ -413,31 +413,69 @@ class NonFiniteGuard:
     those skips, logs each one, fires ``on_step_skipped`` on the net's
     listeners, and raises once more than ``budget`` steps were skipped —
     a diverging run fails loudly instead of free-running on stale params.
+
+    When the caller hands the failing batch to :meth:`step`, the guard
+    additionally runs NaN layer-of-origin attribution
+    (``util.health.attribute_nonfinite``): a diagnostic replay names the
+    first offending layer/param, which is stamped into the skip reason,
+    the ``on_step_skipped`` info dict, and the ``step_skipped`` flight
+    event — so a skipped step explains WHERE the run diverged, not just
+    that it did. ``attribute=False`` disables the replay (it costs one
+    un-jitted forward+backward per skip).
     """
 
-    def __init__(self, budget: int, net=None):
+    def __init__(self, budget: int, net=None,
+                 model_name: Optional[str] = None, attribute: bool = True):
         self.budget = int(budget)
         self.net = net
+        self.model_name = model_name or (
+            type(net).__name__ if net is not None else "net")
+        self.attribute = attribute
         self.skipped = 0
+        self.last_attribution = None
 
-    def step(self, ok, detail: str = "") -> None:
+    def step(self, ok, detail: str = "", batch=None, params=None) -> None:
         """Record one step's device-computed finiteness flag. ``detail``
         qualifies partial skips (e.g. local-SGD, where only some replicas
-        suppressed their update)."""
+        suppressed their update). ``batch`` is the (x, y, mask) the step
+        consumed — when given, a skip triggers layer-of-origin
+        attribution; ``params`` overrides the param tree the replay reads
+        (callers whose step donated ``net.params`` pass the returned,
+        still-valid tree)."""
         if bool(ok):
             return
         self.skipped += 1
         net = self.net
         iteration = getattr(net, "iteration_count", self.skipped)
+        report = None
+        if self.attribute and batch is not None and net is not None:
+            try:
+                from . import health as _health
+                x, y, mask = (tuple(batch) + (None, None))[:3]
+                report = _health.attribute_nonfinite(
+                    net, x, y, mask, params=params,
+                    model=self.model_name, iteration=iteration)
+                self.last_attribution = report
+            except Exception:
+                logger.exception("NaN layer-of-origin attribution failed")
         reason = ("non-finite gradients" + (f" ({detail})" if detail else ""))
+        if report is not None:
+            reason += f" — {report.summary()}"
+        info = {"model": self.model_name, "iteration": int(iteration),
+                "layer": report.layer if report is not None else None,
+                "quantity": report.quantity if report is not None else None,
+                "param": report.param if report is not None else None}
         logger.warning(
             "%s at iteration %s — update suppressed (%d/%d budget)",
             reason, iteration, self.skipped, self.budget)
+        from . import flightrecorder as _flight
+        _flight.record("step_skipped", reason=reason, skipped=self.skipped,
+                       budget=self.budget, **info)
+        from ..optimize.listeners import fire_step_skipped
         for l in getattr(net, "listeners", []) or []:
-            hook = getattr(l, "on_step_skipped", None)
-            if hook is not None:
-                hook(net, iteration, reason)
+            fire_step_skipped(l, net, iteration, reason, info)
         if self.skipped > self.budget:
             raise ResilienceError(
                 f"{self.skipped} training steps skipped for non-finite "
-                f"gradients (budget {self.budget}) — the run is diverging")
+                f"gradients (budget {self.budget}) — the run is "
+                f"diverging{'; ' + report.summary() if report else ''}")

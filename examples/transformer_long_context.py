@@ -31,7 +31,7 @@ def main(smoke: bool = False):
     V = 8
     # T>=4096 routes attention to the flash kernel ON TPU (see
     # ops/flash_attention.flash_available); smoke stays tiny for CI
-    T, steps = (16, 60) if smoke else (4096, 200)
+    T, steps = (16, 12) if smoke else (4096, 200)
     net = ComputationGraph(transformer_lm(
         V, n_layers=2, d_model=32 if smoke else 256,
         n_heads=2 if smoke else 4, d_ff=64 if smoke else 1024,
@@ -62,7 +62,8 @@ def main(smoke: bool = False):
             updater="adam", learning_rate=1e-2)).init()
         tr = SequenceParallelGraphTrainer(sp_net, create_mesh({"seq": n}))
         xs, ys, _ = cyclic_batch(V, 4, 8 * n)
-        losses = [float(tr.fit_batch(xs, ys)) for _ in range(40)]
+        losses = [float(tr.fit_batch(xs, ys))
+                  for _ in range(8 if smoke else 40)]
         print(f"sequence-parallel DSL transformer ({n} devices): loss "
               f"{losses[0]:.3f} -> {losses[-1]:.3f}")
 

@@ -1,0 +1,574 @@
+"""Training-health telemetry tests (ISSUE 15): on-device per-layer stats
+fused into the train dispatch, the health-rules engine, NaN layer-of-origin
+attribution, and the /debug/health surfaces.
+
+Budget note: everything shares the module-scoped ``stats_run`` fixture
+(ONE stats-enabled training run — also the healthy-baseline golden
+scenario) wherever possible; the remaining tests compile only tiny MLPs.
+"""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util import flightrecorder, health
+from deeplearning4j_tpu.util.ingest import retrace_counter, sync_counter
+from deeplearning4j_tpu.util.metrics import REGISTRY, MetricsRegistry
+
+
+def _mlp_conf(seed=1, lr=1e-3, updater="adam"):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(updater)
+            .learning_rate(lr).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+
+
+def _batch(rng, n=16):
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def stats_run():
+    """One stats-enabled training run shared across the module: tiny MLP,
+    adam @1e-3 — which doubles as the HEALTHY-BASELINE golden scenario —
+    30 iterations with a HealthListener at frequency=10."""
+    rng = np.random.default_rng(12345)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    reg = MetricsRegistry()
+    listener = health.HealthListener(frequency=10, model="fixture",
+                                     registry=reg)
+    net.set_listeners(listener)
+    x, y = _batch(rng)
+    s0 = sync_counter().total()
+    for _ in range(30):
+        net.fit_batch(x, y)
+    syncs_during_run = sync_counter().total() - s0
+    snapshot = health.latest_stats(net).value()
+    return {"net": net, "listener": listener, "engine": listener.engine,
+            "registry": reg, "syncs": syncs_during_run, "x": x, "y": y,
+            "snapshot": snapshot}
+
+
+class TestOnDeviceStats:
+    def test_snapshot_contents(self, stats_run):
+        snap = stats_run["snapshot"]
+        assert set(snap) == {"layer_0", "layer_1", "layer_2",
+                             health.MODEL_KEY}
+        for name in ("layer_0", "layer_1", "layer_2"):
+            e = snap[name]
+            assert e["param_norm"] > 0
+            assert e["grad_norm"] > 0
+            assert e["update_norm"] > 0
+            assert e["update_ratio"] == pytest.approx(
+                e["update_norm"] / e["param_norm"], rel=1e-4)
+            assert e["grad_nonfinite"] == 0
+            # fixed-edge log histograms count every (finite) element
+            n_params = sum(
+                int(np.prod(np.asarray(p).shape))
+                for p in jax.tree_util.tree_leaves(
+                    stats_run["net"].params[name]))
+            assert sum(e["param_hist"]) == n_params
+            assert sum(e["update_hist"]) == n_params
+            assert len(e["param_hist"]) == health.HIST_LEN
+        # activation stats for the hidden layers only (the output layer's
+        # activation never materializes in the fused loss)
+        assert 0.0 <= snap["layer_0"]["act_zero_frac"] <= 1.0  # relu
+        assert snap["layer_1"]["act_std"] > 0
+        assert "act_mean" not in snap["layer_2"]
+        # the model-wide entry carries the step loss (the window's score)
+        m = snap[health.MODEL_KEY]
+        assert np.isfinite(m["loss"]) and m["grad_nonfinite"] == 0
+
+    def test_one_sync_per_listener_window(self, stats_run):
+        # 30 iterations at frequency=10 → windows at 10/20/30 → exactly
+        # 3 host syncs for the whole run: the stats snapshot carries the
+        # loss, so the LazyScore is never separately resolved
+        assert stats_run["syncs"] == 3
+
+    def test_stats_step_is_bit_identical_and_separately_guarded(self, rng):
+        x, y = _batch(rng)
+        conf = _mlp_conf(seed=9)
+        c = retrace_counter()
+        plain0 = c.value(fn="MultiLayerNetwork.train_step")
+        stats0 = c.value(fn="MultiLayerNetwork.train_step_stats")
+        net_a = MultiLayerNetwork(conf).init()
+        net_b = MultiLayerNetwork(conf).init()
+        net_b.enable_health_stats()
+        for _ in range(3):
+            la = net_a.fit_batch(x, y)
+            lb = net_b.fit_batch(x, y)
+        assert float(la) == float(lb)
+        for a, b in zip(
+                jax.tree_util.tree_leaves(jax.device_get(net_a.params)),
+                jax.tree_util.tree_leaves(jax.device_get(net_b.params))):
+            np.testing.assert_array_equal(a, b)
+        # each variant compiled exactly once, under its own guard name —
+        # the no-stats trace pin does not move when stats are enabled
+        assert c.value(fn="MultiLayerNetwork.train_step") == plain0 + 1
+        assert c.value(fn="MultiLayerNetwork.train_step_stats") == stats0 + 1
+        # toggling off reuses the cached no-stats trace: no new compile
+        net_b.disable_health_stats()
+        net_b.fit_batch(x, y)
+        assert c.value(fn="MultiLayerNetwork.train_step") == plain0 + 2
+        net_b.enable_health_stats()
+        net_b.fit_batch(x, y)
+        assert c.value(fn="MultiLayerNetwork.train_step_stats") == stats0 + 1
+
+    def test_listener_ignores_stale_snapshot(self, stats_run):
+        """A HealthListener only observes a snapshot produced by THIS
+        iteration's dispatch: a stale DeviceStats (fit_scan interior
+        iterations, or a model whose stats stopped) is skipped instead of
+        being republished with a wrong iteration label."""
+        l = health.HealthListener(frequency=1, model="stale",
+                                  registry=MetricsRegistry())
+        l.iteration_done(stats_run["net"],
+                         stats_run["net"].iteration_count + 999, 0.0)
+        assert l.engine.last_report is None
+
+    def test_fit_scan_emits_last_step_stats(self, stats_run):
+        net, x, y = stats_run["net"], stats_run["x"], stats_run["y"]
+        it0 = net.iteration_count
+        # K=2 keeps the scan trace small (unroll multiplies the stats
+        # reductions into the program); the contract is identical at any K
+        net.fit_scan(np.stack([x] * 2), np.stack([y] * 2))
+        ds = health.latest_stats(net)
+        assert ds.iteration == it0 + 2
+        snap = ds.value()
+        assert np.isfinite(snap[health.MODEL_KEY]["loss"])
+        assert snap["layer_0"]["param_norm"] > 0
+
+
+    def test_fit_repeated_emits_last_step_stats(self, stats_run):
+        net, x, y = stats_run["net"], stats_run["x"], stats_run["y"]
+        it0 = net.iteration_count
+        net.fit_repeated(x, y, 3)
+        ds = health.latest_stats(net)
+        assert ds.iteration == it0 + 3
+        snap = ds.value()
+        assert np.isfinite(snap[health.MODEL_KEY]["loss"])
+        assert snap["layer_1"]["update_ratio"] > 0
+
+    def test_listener_observes_offgrid_scan_windows(self, stats_run):
+        """fit_scan/fit_repeated windows whose final iterations never
+        align with the listener frequency still get observed about every
+        `frequency` iterations (not only at lcm(frequency, k))."""
+        net, x, y = stats_run["net"], stats_run["x"], stats_run["y"]
+        l = health.HealthListener(frequency=10, model="offgrid",
+                                  registry=MetricsRegistry())
+        net.fit_repeated(x, y, 3)
+        l.iteration_done(net, net.iteration_count, 0.0)
+        assert l.engine.last_report is not None
+        assert l.engine.last_report["iteration"] == net.iteration_count
+
+class TestGraphStats:
+    def test_graph_stats_keyed_by_vertex(self, rng):
+        from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(3).updater("adam")
+                .learning_rate(1e-3).graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=8, activation="relu"),
+                           "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "d1")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(5)).build())
+        net = ComputationGraph(conf).init()
+        net.enable_health_stats()
+        x, y = _batch(rng)
+        net.fit_batch(x, y)
+        snap = health.latest_stats(net).value()
+        assert "d1" in snap and health.MODEL_KEY in snap
+        assert snap["d1"]["param_norm"] > 0
+        assert 0.0 <= snap["d1"]["act_zero_frac"] <= 1.0
+        assert np.isfinite(snap[health.MODEL_KEY]["loss"])
+
+
+def _mk_snapshot(**layers):
+    """Synthetic host snapshot for rule unit tests."""
+    snap = {name: dict(e) for name, e in layers.items()}
+    snap.setdefault(health.MODEL_KEY, {"grad_norm": 1.0,
+                                       "grad_nonfinite": 0, "loss": 1.0})
+    return snap
+
+
+_HEALTHY = {"param_norm": 3.0, "grad_norm": 0.5, "update_norm": 0.003,
+            "update_ratio": 1e-3, "grad_nonfinite": 0,
+            "act_zero_frac": 0.3}
+
+
+class TestHealthRules:
+    def test_update_ratio_band(self):
+        rule = health.UpdateRatioRule()
+        sample = health.HealthSample(_mk_snapshot(
+            l0=dict(_HEALTHY), l1=dict(_HEALTHY, update_ratio=0.5),
+            l2=dict(_HEALTHY, update_ratio=5e-5)), 50, ())
+        verdicts = {v.layer: v.state for v in rule.evaluate(sample)}
+        assert verdicts == {"l0": health.OK, "l1": health.CRITICAL,
+                            "l2": health.WARN}
+        # warmup: the first Adam steps legitimately overshoot the band
+        assert rule.evaluate(health.HealthSample(
+            _mk_snapshot(l0=dict(_HEALTHY, update_ratio=0.5)), 3, ())) == []
+
+    def test_exploding_and_vanishing(self):
+        sample = health.HealthSample(_mk_snapshot(
+            l0=dict(_HEALTHY, grad_norm=1e-8),
+            l1=dict(_HEALTHY, grad_norm=5e3),
+            l2=dict(_HEALTHY, grad_norm=float("inf"))), 50, ())
+        exploding = {v.layer: v.state for v in
+                     health.ExplodingGradientsRule().evaluate(sample)}
+        assert exploding == {"l0": health.OK, "l1": health.WARN,
+                             "l2": health.CRITICAL}
+        # depth ratio: first/last grad norms (inf last layer excluded)
+        vanishing = health.VanishingGradientsRule().evaluate(
+            health.HealthSample(_mk_snapshot(
+                l0=dict(_HEALTHY, grad_norm=1e-9),
+                l1=dict(_HEALTHY, grad_norm=10.0)), 50, ()))
+        assert [(v.layer, v.state) for v in vanishing] == [
+            ("l0", health.CRITICAL)]
+
+    def test_dead_units_and_nonfinite(self):
+        sample = health.HealthSample(_mk_snapshot(
+            l0=dict(_HEALTHY, act_zero_frac=1.0),
+            l1=dict(_HEALTHY, act_zero_frac=0.95),
+            l2=dict(_HEALTHY, grad_nonfinite=7)), 50, ())
+        dead = {v.layer: v.state for v in
+                health.DeadUnitsRule().evaluate(sample)}
+        assert dead["l0"] == health.CRITICAL
+        assert dead["l1"] == health.WARN
+        assert dead["l2"] == health.OK
+        nf = {v.layer: v.state for v in
+              health.NonFiniteGradientsRule().evaluate(sample)}
+        assert nf == {"l0": health.OK, "l1": health.OK,
+                      "l2": health.CRITICAL}
+
+    def test_loss_divergence_trend(self):
+        rule = health.LossDivergenceRule(window=6)
+        snap = _mk_snapshot(l0=dict(_HEALTHY))
+        ok = rule.evaluate(health.HealthSample(
+            snap, 20, (1.0, 0.9, 0.8, 0.7, 0.6, 0.5)))
+        assert ok[0].state == health.OK
+        warn = rule.evaluate(health.HealthSample(
+            snap, 20, (1.0, 1.0, 1.0, 5.0, 6.0, 7.0)))
+        assert warn[0].state == health.WARN
+        crit = rule.evaluate(health.HealthSample(
+            snap, 20, (1.0, 1.0, 1.0, 200.0, 300.0, 400.0)))
+        assert crit[0].state == health.CRITICAL
+        nan = rule.evaluate(health.HealthSample(
+            snap, 20, (1.0, float("nan"))))
+        assert nan[0].state == health.CRITICAL
+
+    def test_engine_gauges_and_transition_events(self):
+        reg = MetricsRegistry()
+        engine = health.HealthEngine(model="unit", registry=reg)
+        healthy = _mk_snapshot(l0=dict(_HEALTHY))
+        engine.observe(healthy, iteration=20)
+        g = reg.get("training_health_state")
+        assert g.value(model="unit", rule="update_ratio", layer="l0") == 0.0
+        assert reg.get("model_stats_grad_norm").value(
+            model="unit", layer="l0") == pytest.approx(0.5)
+        n_events = len(flightrecorder.events("health_state"))
+        bad = _mk_snapshot(l0=dict(_HEALTHY, update_ratio=0.5))
+        report = engine.observe(bad, iteration=30)
+        assert report["rules"]["update_ratio"]["state"] == health.CRITICAL
+        assert g.value(model="unit", rule="update_ratio", layer="l0") == 2.0
+        events = flightrecorder.events("health_state")[n_events:]
+        assert any(e["rule"] == "update_ratio" and e["layer"] == "l0"
+                   and e["to_state"] == health.CRITICAL for e in events)
+        # recovery transitions are recorded too
+        engine.observe(healthy, iteration=40)
+        events = flightrecorder.events("health_state")
+        assert any(e.get("to_state") == health.OK
+                   and e.get("rule") == "update_ratio" for e in events)
+
+
+class TestGoldenScenarios:
+    def test_healthy_baseline_all_rules_ok(self, stats_run):
+        report = stats_run["engine"].last_report
+        assert report is not None and report["state"] == health.OK
+        for rule, r in report["rules"].items():
+            assert r["state"] == health.OK, (rule, r)
+        assert set(report["rules"]) == {
+            "update_ratio", "exploding_gradients", "vanishing_gradients",
+            "dead_units", "nonfinite_grads", "loss_divergence"}
+
+    def test_exploding_grad_lr(self, rng):
+        # linear layers + mse keep the gradient unbounded (tanh/softmax
+        # would saturate and VANISH it instead): each oversized sgd step
+        # multiplies the prediction error, so grad norms genuinely blow up
+        conf = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+                .learning_rate(1e4).list()
+                .layer(DenseLayer(n_out=16, activation="identity"))
+                .layer(DenseLayer(n_out=8, activation="identity"))
+                .layer(OutputLayer(n_out=3, activation="identity",
+                                   loss="mse"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.enable_health_stats()
+        listener = health.HealthListener(frequency=1, model="exploding",
+                                         registry=MetricsRegistry())
+        net.set_listeners(listener)
+        x, y = _batch(rng)
+        for _ in range(8):
+            net.fit_batch(x, y)
+        report = listener.engine.last_report
+        assert report["state"] == health.CRITICAL
+        # the blowup is visible to the gradient rules (magnitude or
+        # outright non-finite once the params overflowed)
+        states = {r: report["rules"][r]["state"] for r in report["rules"]}
+        assert (states["exploding_gradients"] == health.CRITICAL
+                or states["nonfinite_grads"] == health.CRITICAL)
+
+    def test_dead_relu_init(self, rng):
+        net = MultiLayerNetwork(_mlp_conf(seed=6)).init()
+        # force-dead first layer: zero weights, strongly negative bias —
+        # every relu output is exactly 0
+        net.params["layer_0"]["W"] = jax.numpy.zeros_like(
+            net.params["layer_0"]["W"])
+        net.params["layer_0"]["b"] = (
+            jax.numpy.zeros_like(net.params["layer_0"]["b"]) - 5.0)
+        # enable up front: the listener's lazy enable would only take
+        # effect from the SECOND step, and this scenario fits once
+        net.enable_health_stats()
+        listener = health.HealthListener(frequency=1, model="dead",
+                                         registry=MetricsRegistry())
+        net.set_listeners(listener)
+        x, y = _batch(rng)
+        net.fit_batch(x, y)
+        report = listener.engine.last_report
+        dead = report["rules"]["dead_units"]
+        assert dead["state"] == health.CRITICAL
+        assert dead["layers"]["layer_0"]["state"] == health.CRITICAL
+        snap = health.latest_stats(net).value()
+        assert snap["layer_0"]["act_zero_frac"] == 1.0
+
+
+class TestAttribution:
+    def test_param_origin(self, stats_run):
+        net = stats_run["net"]
+        poisoned = jax.tree_util.tree_map(lambda a: np.asarray(a),
+                                          jax.device_get(net.params))
+        poisoned["layer_1"]["W"] = poisoned["layer_1"]["W"].copy()
+        poisoned["layer_1"]["W"][0, 0] = np.nan
+        r = health.attribute_nonfinite(net, stats_run["x"], stats_run["y"],
+                                       params=poisoned, record=False)
+        assert (r.quantity, r.layer, r.param) == ("param", "layer_1", "W")
+
+    def test_activation_origin(self, stats_run):
+        net = stats_run["net"]
+        poisoned = jax.tree_util.tree_map(lambda a: np.asarray(a),
+                                          jax.device_get(net.params))
+        # finite-but-huge weights overflow the layer_0 matmul to inf
+        poisoned["layer_0"]["W"] = np.full_like(
+            poisoned["layer_0"]["W"], 3e38)
+        r = health.attribute_nonfinite(net, stats_run["x"], stats_run["y"],
+                                       params=poisoned, record=False)
+        assert (r.quantity, r.layer) == ("activation", "layer_0")
+
+    def test_gradient_origin_is_closest_to_loss(self, stats_run):
+        net = stats_run["net"]
+        # poisoned labels make the LOSS non-finite while every forward
+        # quantity stays finite: gradient NaNs flow backward from the
+        # loss, so the attributed origin is the LAST layer
+        y_bad = stats_run["y"].copy()
+        y_bad[0, 0] = np.nan
+        r = health.attribute_nonfinite(net, stats_run["x"], y_bad,
+                                       record=False)
+        assert (r.quantity, r.layer) == ("gradient", "layer_2")
+
+    def test_input_origin(self, stats_run):
+        x_bad = stats_run["x"].copy()
+        x_bad[0, 0] = np.inf
+        r = health.attribute_nonfinite(stats_run["net"], x_bad,
+                                       stats_run["y"], record=False)
+        assert r.quantity == "input" and r.layer is None
+
+
+class TestGuardAttribution:
+    """Acceptance: an injected non-finite gradient produces a skip event,
+    /debug/health, and a flight dump ALL naming the same origin layer."""
+
+    def _run_poisoned(self, rng, reg):
+        from deeplearning4j_tpu.optimize.listeners import (MetricsListener,
+                                                           TrainingListener)
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        net = MultiLayerNetwork(_mlp_conf(seed=7, lr=0.1,
+                                          updater="sgd")).init()
+        events = []
+
+        class Hook(TrainingListener):
+            def on_step_skipped(self, model, iteration, reason, info=None):
+                events.append((reason, info))
+
+        net.set_listeners(MetricsListener(registry=reg, name="guarded"),
+                          Hook())
+        pw = ParallelWrapper(net, skip_nonfinite_budget=3)
+        x, y = _batch(rng, n=8)
+        pw.fit_batch(x, y)                  # healthy warm-up
+        y_bad = y.copy()
+        y_bad[0, 0] = np.nan               # non-finite gradients, finite fwd
+        pw.fit_batch(x, y_bad)
+        return net, pw, events
+
+    def test_skip_event_debug_health_and_flight_dump_agree(self, rng,
+                                                           tmp_path):
+        health.reset_debug_state()
+        reg = MetricsRegistry()
+        net, pw, events = self._run_poisoned(rng, reg)
+        assert pw.nonfinite_guard.skipped == 1
+        # 1) the listener hook got the structured context
+        reason, info = events[0]
+        assert info["layer"] == "layer_2"
+        assert info["quantity"] == "gradient"
+        assert "layer_2" in reason
+        # 2) the metrics label names the same layer
+        assert reg.get("training_steps_skipped_total").value(
+            model="guarded", layer="layer_2") == 1
+        # 3) /debug/health (module payload + both HTTP servers below)
+        payload = health.debug_payload()
+        assert payload["attribution"]["layer"] == "layer_2"
+        # 4) the flight dump names the same layer in the skip event AND
+        # the attribution event
+        path = str(tmp_path / "flight.jsonl")
+        flightrecorder.dump(reason="test", path=path)
+        dumped = flightrecorder.read_jsonl(path)
+        skips = [e for e in dumped if e.get("kind") == "step_skipped"]
+        attrs = [e for e in dumped
+                 if e.get("kind") == "nonfinite_attribution"]
+        assert skips and skips[-1]["layer"] == "layer_2"
+        assert attrs and attrs[-1]["layer"] == "layer_2"
+
+    def test_debug_health_served_on_both_servers(self, rng):
+        from deeplearning4j_tpu.serving.server import InferenceServer
+        from deeplearning4j_tpu.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui import UIServer
+        if health.last_attribution() is None:
+            health._remember_attribution(health.AttributionReport(
+                model="m", iteration=1, quantity="gradient",
+                layer="layer_2"))
+        ui = UIServer(port=0).attach(InMemoryStatsStorage())
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/debug/health",
+                timeout=5).read())
+            assert body["attribution"]["layer"] == "layer_2"
+            assert "histogram_log10_edges" in body
+        finally:
+            ui.stop()
+        net = MultiLayerNetwork(_mlp_conf(seed=8)).init()
+        srv = InferenceServer(net, port=0)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/health",
+                timeout=5).read())
+            assert body["attribution"]["layer"] == "layer_2"
+        finally:
+            srv.stop()
+
+
+class TestStatsListenerDevicePath:
+    def test_device_stats_route_and_sync_pin(self, stats_run):
+        """Regression (ISSUE 15 satellite): with the on-device pass the
+        listener posts model stats WITHOUT device_get-ing param tensors,
+        at exactly one host sync per collected window."""
+        from deeplearning4j_tpu.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui import StatsListener
+        net = stats_run["net"]            # stats already enabled+compiled
+        st = InMemoryStatsStorage()
+        listener = StatsListener(st, frequency=2, session_id="dev",
+                                 device_stats=None)  # consume-only
+        net.set_listeners(listener)
+        it0 = net.iteration_count
+        s0 = sync_counter().total()
+        for _ in range(8):
+            net.fit_batch(stats_run["x"], stats_run["y"])
+        windows = sum(1 for i in range(it0 + 1, it0 + 9) if i % 2 == 0)
+        assert sync_counter().total() - s0 == windows
+        ups = st.get_all_updates_after("dev", "StatsListener",
+                                       "worker_0", 0.0)
+        assert len(ups) == windows
+        data = ups[-1].data
+        assert data["model_stats"]["layers"]["layer_0"]["param_norm"] > 0
+        # the UI-compatible per-layer projection, histograms included
+        p = data["parameters"]["layer_0"]
+        assert p["norm"] > 0 and p["histogram"]["log10_abs"]
+        assert np.isfinite(data["score"])
+        # restore the fixture's own listener for later tests
+        net.set_listeners(stats_run["listener"])
+
+    def test_device_stats_true_enables_on_model(self):
+        class FakeModel:
+            health_stats = None
+            enabled = False
+
+            def enable_health_stats(self, config=True):
+                self.enabled = True
+
+        from deeplearning4j_tpu.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui import StatsListener
+        m = FakeModel()
+        listener = StatsListener(InMemoryStatsStorage(),
+                                 device_stats=True)
+        listener.on_epoch_start(m, 0)
+        assert m.enabled
+
+    def test_device_stats_falls_back_on_override_stepped_net(self, rng,
+                                                             caplog):
+        """device_stats=True on a net whose train step never produces
+        stats (a pinned step override, e.g. a sharded trainer's) must not
+        silently post nothing: after the first (expected) miss it warns
+        once and falls back to the legacy host parameter path."""
+        import logging as _logging
+        from deeplearning4j_tpu.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui import StatsListener
+        net = MultiLayerNetwork(_mlp_conf(seed=13)).init()
+        # a pinned override is consulted before the stats-keyed cache, so
+        # enable_health_stats() becomes a no-op — the wrapper scenario
+        net._jit_cache["train_step_override"] = net._make_train_step()
+        st = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(
+            st, frequency=1, session_id="fb", histogram_frequency=1,
+            device_stats=True))
+        x, y = _batch(rng)
+        with caplog.at_level(_logging.WARNING, "deeplearning4j_tpu"):
+            for _ in range(3):
+                net.fit_batch(x, y)
+        ups = st.get_all_updates_after("fb", "StatsListener",
+                                       "worker_0", 0.0)
+        assert "parameters" not in ups[0].data        # first miss: quiet
+        assert "parameters" in ups[-1].data           # then host fallback
+        assert ups[-1].data["parameters"]              # real host norms
+        assert any("falling back to the host parameter path" in r.message
+                   for r in caplog.records)
+
+    def test_legacy_host_path_skips_histograms(self, rng):
+        """collect_norms=True posts norms without materializing numpy
+        histograms; collect_histograms=True keeps the old shape."""
+        from deeplearning4j_tpu.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui import StatsListener
+        net = MultiLayerNetwork(_mlp_conf(seed=11)).init()
+        st = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(
+            st, frequency=1, session_id="legacy", collect_norms=True,
+            histogram_frequency=1, device_stats=False))
+        x, y = _batch(rng)
+        for _ in range(2):
+            net.fit_batch(x, y)
+        ups = st.get_all_updates_after("legacy", "StatsListener",
+                                       "worker_0", 0.0)
+        params = ups[-1].data["parameters"]
+        entry = next(iter(params.values()))
+        assert "norm" in entry and "histogram" not in entry
+        upd = entry.get("update")
+        assert upd is not None and "histogram" not in upd

@@ -520,9 +520,12 @@ class TestMetricsListener:
         reg = MetricsRegistry()
         l = MetricsListener(registry=reg, name="guarded")
         l.on_step_skipped(None, 3, "non-finite gradients")
-        l.on_step_skipped(None, 4, "non-finite gradients")
+        l.on_step_skipped(None, 4, "non-finite gradients",
+                          info={"layer": "layer_1"})
         assert reg.get("training_steps_skipped_total").value(
-            model="guarded") == 2
+            model="guarded", layer="") == 1
+        assert reg.get("training_steps_skipped_total").value(
+            model="guarded", layer="layer_1") == 1
 
 
 class TestTrainingStatsMirror:
@@ -1022,14 +1025,15 @@ class TestMetricsConventions:
 
     def test_representative_families_obey_conventions(self):
         """Deterministic coverage independent of test order: register
-        the elastic / tracing / xla / decode / serving metric families
-        into a fresh registry and lint them."""
+        the elastic / tracing / xla / decode / serving / health metric
+        families into a fresh registry and lint them."""
         from deeplearning4j_tpu.models import transformer_lm
         from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+        from deeplearning4j_tpu.optimize import MetricsListener
         from deeplearning4j_tpu.parallel import elastic
         from deeplearning4j_tpu.serving.decode import (DecodeScheduler,
                                                        PagedDecodeEngine)
-        from deeplearning4j_tpu.util import tracing, xla
+        from deeplearning4j_tpu.util import health, tracing, xla
 
         reg = MetricsRegistry()
         elastic.rounds_counter(reg)
@@ -1041,6 +1045,13 @@ class TestMetricsConventions:
         xla.compile_seconds_histogram(reg)
         xla.compiled_flops_gauge(reg)
         xla.compiled_bytes_gauge(reg)
+        # training-health telemetry (ISSUE 15): the engine registers
+        # training_health_state + the model_stats_* gauges, the listener
+        # the layer-labeled skip counter. The per-layer `layer` label is
+        # bounded by model DEPTH (layer keys / vertex names), so the
+        # ≤128-series cardinality lint holds for any in-tree model.
+        health.HealthEngine(model="lint", registry=reg)
+        MetricsListener(registry=reg, name="lint")
         # a scheduler construction registers the whole decode plane
         # (goodput split included); no dispatch, so this is cheap
         net = ComputationGraph(transformer_lm(
