@@ -895,6 +895,164 @@ def bench_transformer_lm() -> dict:
     return out
 
 
+def _bench_prefix_cache(net, baseline_engine, vocab, lanes, page_size,
+                        pages_per_seq, block_len) -> dict:
+    """Shared-prefix serving A/B + int8 KV-quantization quality/capacity
+    (ISSUE 19), appended to the decode payload:
+
+    - **prefix_hit_ttft_ms** — TTFT for requests whose WHOLE prompt is
+      resident in the prefix index (a warm 2-page system prompt): the
+      acceptance claim is that a full hit skips prefill entirely and
+      pays roughly one decode-step dispatch. Partial hits (shared
+      prefix + private tail) and the same Poisson schedule replayed on
+      the warm prefix-off engine give the contrast rows.
+    - **kv_prefix_hit_rate** — covered prompt tokens / total prompt
+      tokens over the measured schedule (plus the admission-outcome
+      counts from ``kv_prefix_hits_total``).
+    - **int8_logit_max_err** — max |Δ log p| of the int8 paged forward
+      vs the dense float oracle (``oracle_stream_probs``) over a
+      4-page sequence, plus the greedy-divergence rate: the measured
+      quality bound PERF.md records for the quantized arena.
+    - **concurrent_lanes_at_fixed_arena** — lanes a fixed arena byte
+      budget sustains at fp vs int8 pools (int8 codes + per-(page,
+      head) scales ≈ ¼ the bytes → ~4× pages), cross-checked by
+      actually running the int8 engine at the computed lane count and
+      recording the peak concurrently-active lanes.
+    """
+    from deeplearning4j_tpu.models.transformer import (
+        attention_vertices, oracle_stream_probs, paged_decode_forward)
+    from deeplearning4j_tpu.serving.decode import (DecodeScheduler,
+                                                   PagedDecodeEngine)
+    from deeplearning4j_tpu.serving.kv_cache import PagedKVArena
+    from deeplearning4j_tpu.util.metrics import MetricsRegistry
+
+    out = {}
+    ps = page_size
+    rng = np.random.default_rng(53)
+    sys_prompt = rng.integers(0, vocab, 2 * ps).astype(np.int32)
+    tails = rng.integers(0, vocab, (12, ps // 2)).astype(np.int32)
+    # 11 exact repeats of the system prompt (full hits once seeded) +
+    # 12 shared-prefix-plus-private-tail prompts (partial hits)
+    schedule = [sys_prompt] * 11 + [np.concatenate([sys_prompt, t])
+                                    for t in tails]
+    order = rng.permutation(len(schedule))
+    arrivals = np.cumsum(rng.exponential(0.002, len(schedule)))
+    max_new = 8
+
+    def poisson(sched):
+        reqs = [None] * len(schedule)
+        t0 = time.perf_counter()
+        for i, k in enumerate(order):
+            dt = arrivals[i] - (time.perf_counter() - t0)
+            if dt > 0:
+                time.sleep(dt)
+            reqs[k] = sched.submit(schedule[k], max_new)
+        for r in reqs:
+            r.wait(600)
+        return reqs
+
+    reg = MetricsRegistry()
+    eng = PagedDecodeEngine(net, max_batch=lanes, page_size=ps,
+                            pages_per_seq=pages_per_seq, prefill_chunk=ps,
+                            block_len=block_len, prefix_cache=True,
+                            registry=reg)
+    eng.warmup()
+    sched = DecodeScheduler(eng, registry=reg, max_queue=64,
+                            request_timeout_s=600.0)
+    # seed the index (the measured schedule runs against a warm cache)
+    seed = sched.submit(sys_prompt, max_new)
+    seed.wait(600)
+    reqs = poisson(sched)
+    sched.stop()
+
+    # the same schedule on the WARM prefix-off fused engine — the
+    # prefill-every-time TTFT the hit rows are read against
+    base_sched = DecodeScheduler(baseline_engine, max_queue=64,
+                                 request_timeout_s=600.0)
+    base_reqs = poisson(base_sched)
+    base_sched.stop()
+    for r, b in zip(reqs, base_reqs):
+        assert r.tokens == b.tokens, \
+            "prefix-cache greedy output diverged from the prefill path"
+
+    def p50(ttfts):
+        s = sorted(ttfts)
+        return round(1000 * s[len(s) // 2], 3) if s else None
+
+    full = [r for r in reqs
+            if r.prefix_covered_tokens >= len(r.prompt)]
+    partial = [r for r in reqs
+               if 0 < r.prefix_covered_tokens < len(r.prompt)]
+    hits = reg.get("kv_prefix_hits_total")
+    out["prefix_hit_ttft_ms"] = p50(
+        [r.t_first_token - r.t_submit for r in full])
+    out["prefix_partial_ttft_ms"] = p50(
+        [r.t_first_token - r.t_submit for r in partial])
+    out["prefill_ttft_ms"] = p50(
+        [r.t_first_token - r.t_submit for r in base_reqs])
+    out["kv_prefix_hit_rate"] = round(
+        sum(r.prefix_covered_tokens for r in reqs)
+        / sum(len(r.prompt) for r in reqs), 4)
+    out["kv_prefix_hits"] = {
+        k: int(hits.value(result=k)) for k in ("full", "partial", "miss")}
+    out["kv_prefix_cow_detaches"] = int(
+        reg.get("kv_pages_cow_total").value())
+
+    # ---- int8 quality bound vs the dense float oracle ----------------
+    dims = {}
+    for name in attention_vertices(net):
+        layer = net.conf.vertices[name].layer
+        dims[name] = (layer.n_heads, layer.n_in // layer.n_heads)
+    t = 4 * ps
+    seq = rng.integers(0, vocab, t).astype(np.int32)
+    oracle = oracle_stream_probs(net, seq)                  # [t, V]
+    q8 = PagedKVArena(dims, num_pages=pages_per_seq, page_size=ps,
+                      kv_dtype="int8", with_allocator=False)
+    probs, _, _ = paged_decode_forward(
+        net, net.params, q8.k_pools, q8.v_pools, seq[None],
+        np.arange(pages_per_seq, dtype=np.int32)[None],
+        np.arange(t, dtype=np.int32)[None], np.zeros(1, np.int32))
+    probs = np.asarray(probs, np.float64)[0]
+    out["int8_logit_max_err"] = round(float(np.max(np.abs(
+        np.log(np.maximum(probs, 1e-12))
+        - np.log(np.maximum(oracle, 1e-12))))), 5)
+    out["int8_greedy_divergence"] = round(float(np.mean(
+        np.argmax(probs, axis=-1) != np.argmax(oracle, axis=-1))), 4)
+
+    # ---- lane capacity at fixed arena bytes --------------------------
+    per_fp = PagedKVArena(dims, num_pages=1, page_size=ps,
+                          with_allocator=False).nbytes()
+    per_q8 = PagedKVArena(dims, num_pages=1, page_size=ps,
+                          kv_dtype="int8", with_allocator=False).nbytes()
+    arena_bytes = lanes * pages_per_seq * per_fp
+    q8_pages = int(arena_bytes // per_q8)
+    q8_lanes = q8_pages // pages_per_seq
+    qreg = MetricsRegistry()
+    qeng = PagedDecodeEngine(net, max_batch=q8_lanes, page_size=ps,
+                             pages_per_seq=pages_per_seq,
+                             num_pages=q8_pages, prefill_chunk=ps,
+                             block_len=block_len, kv_dtype="int8",
+                             registry=qreg)
+    qsched = DecodeScheduler(qeng, registry=qreg,
+                             max_queue=q8_lanes + 8,
+                             request_timeout_s=600.0)
+    qprompts = rng.integers(0, vocab, (q8_lanes, ps)).astype(np.int32)
+    qreqs = [qsched.submit(p, 24) for p in qprompts]
+    peak = 0
+    while not all(r.done for r in qreqs):
+        peak = max(peak, qsched.active_count())
+        time.sleep(0.005)
+    qsched.stop()
+    out["concurrent_lanes_at_fixed_arena"] = {
+        "arena_mib": round(arena_bytes / 2 ** 20, 2),
+        "fp_lanes": lanes,
+        "int8_lanes": q8_lanes,
+        "int8_sustained_active_lanes": peak,
+        "capacity_ratio": round(q8_lanes / lanes, 2),
+    }
+    return out
+
+
 def bench_decode() -> dict:
     """Decode-serving A/B under one OPEN-LOOP Poisson arrival schedule
     (ISSUE 9 + ISSUE 11 acceptance): sustained tokens/s plus p50/p99
@@ -1185,6 +1343,9 @@ def bench_decode() -> dict:
                      key=lambda t: t["attributes"].get("tokens", 0))
         out["sample_request_timeline"] = json.loads(
             json.dumps(sample, default=repr))
+    # ---- prefix caching + int8 KV quantization (ISSUE 19) ------------
+    out.update(_bench_prefix_cache(net, fused["engine"], vocab, lanes,
+                                   page_size, pages_per_seq, block_len))
     return out
 
 

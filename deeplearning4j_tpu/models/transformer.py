@@ -233,6 +233,36 @@ def generate(net, prompt_ids, max_new_tokens: int, *,
     return np.asarray(toks, np.int32)
 
 
+def oracle_stream_probs(net, token_ids) -> np.ndarray:
+    """Per-position next-token distributions from the dense full-cache
+    streaming path — the float32 quality oracle the int8 KV-page
+    quantization gate compares against (``tests/test_prefix_cache.py``,
+    ``bench.py``'s ``int8_logit_max_err``).
+
+    Feeds ``token_ids`` through ``rnn_time_step`` with the same
+    chunk-then-token schedule as :func:`generate` (first window in one
+    chunk, tail token by token, so past-window positions see the exact
+    sliding window) and returns ``[len(token_ids), V]`` float64 — row i
+    is the model's distribution over the token FOLLOWING position i."""
+    from ..util.netutil import streaming_cache_limit
+    limit = streaming_cache_limit(net)
+    if limit is None:
+        raise ValueError(
+            "oracle_stream_probs() needs streaming K/V caches — build "
+            "the net with transformer_lm(..., max_cache_t=...)")
+    ids = np.asarray(token_ids, np.int32).reshape(-1)
+    if ids.size < 1:
+        raise ValueError("oracle_stream_probs() needs at least one token")
+    net.rnn_clear_previous_state()
+    first = min(len(ids), limit)
+    rows = [np.asarray(net.rnn_time_step(ids[None, :first, None]),
+                       np.float64)[0]]
+    for i in range(first, len(ids)):
+        step = net.rnn_time_step(ids[None, i:i + 1, None])
+        rows.append(np.asarray(step, np.float64)[0])
+    return np.concatenate(rows, axis=0)
+
+
 def paged_decode_forward(net, params, k_pools, v_pools, ids, page_tables,
                          write_slots, rel_pos):
     """ONE traced forward of an ids-mode ``transformer_lm`` graph in

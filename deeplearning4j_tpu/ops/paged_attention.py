@@ -42,12 +42,16 @@ __all__ = ["paged_write", "paged_gather", "paged_attention"]
 def paged_write(pool, new, page_table, write_slots):
     """Scatter new K (or V) rows into the block pool.
 
-    pool: ``[num_pages, page_size, h, d]``; new: ``[S, t_new, h, d]``;
-    page_table: ``[S, P]`` physical page ids; write_slots: ``[S, t_new]``
-    view-relative slot per token (``-1`` = padded, dropped). Returns the
-    updated pool. Out-of-range/sentinel targets are dropped, so padded
-    lanes can never corrupt a live page.
+    pool: ``[num_pages, page_size, h, d]`` — or, int8-quantized, a
+    ``(q_int8, scales)`` tuple (see :func:`_paged_write_q8`); new:
+    ``[S, t_new, h, d]``; page_table: ``[S, P]`` physical page ids;
+    write_slots: ``[S, t_new]`` view-relative slot per token (``-1`` =
+    padded, dropped). Returns the updated pool (same structure as the
+    input). Out-of-range/sentinel targets are dropped, so padded lanes
+    can never corrupt a live page.
     """
+    if isinstance(pool, tuple):
+        return _paged_write_q8(pool, new, page_table, write_slots)
     num_pages, page_size = pool.shape[0], pool.shape[1]
     p_idx = jnp.clip(write_slots // page_size, 0, page_table.shape[1] - 1)
     off = write_slots % page_size
@@ -58,13 +62,68 @@ def paged_write(pool, new, page_table, write_slots):
     return pool.at[phys, off].set(new.astype(pool.dtype), mode="drop")
 
 
+def _paged_write_q8(pool, new, page_table, write_slots):
+    """int8 write path: ``pool = (q, scales)`` with ``q`` the
+    ``[num_pages, page_size, h, d]`` int8 codes and ``scales`` the
+    per-(page, head) ``[num_pages, h]`` f32 quantization step.
+
+    Scales are MONOTONE per page: a write first folds the new rows'
+    amax into ``new_scale = max(old_scale, amax/127)``, rescales the
+    touched pages' existing codes by ``old/new`` (duplicate page ids
+    scatter identical values, so the update is idempotent), then writes
+    the new rows quantized at the new scale. Monotonicity keeps already
+    written tokens valid without tracking per-row scales; the bounded
+    requantization drift it costs is covered by the int8 quality gate
+    (logit max-err + greedy divergence, see PERF.md).
+    """
+    q, scales = pool
+    num_pages, page_size = q.shape[0], q.shape[1]
+    h = q.shape[2]
+    p_idx = jnp.clip(write_slots // page_size, 0, page_table.shape[1] - 1)
+    off = write_slots % page_size
+    phys = jnp.take_along_axis(page_table, p_idx, axis=1)
+    phys = jnp.where(write_slots >= 0, phys, num_pages)       # [S, t]
+    newf = new.astype(jnp.float32)
+    # 1) fold the new rows' amax into the touched pages' scales
+    amax_tok = jnp.max(jnp.abs(newf), axis=-1)                # [S, t, h]
+    flat_phys = phys.reshape(-1)
+    amax_page = (jnp.zeros((num_pages, h), jnp.float32)
+                 .at[flat_phys].max(amax_tok.reshape(-1, h), mode="drop"))
+    new_scales = jnp.maximum(scales, amax_page / 127.0)
+    # 2) rescale ONLY the touched pages' existing codes to the new step
+    ratio = jnp.where(new_scales > 0, scales / new_scales, 0.0)
+    pages_q = jnp.take(q, flat_phys, axis=0, mode="fill", fill_value=0)
+    r = jnp.take(ratio, flat_phys, axis=0,
+                 mode="fill", fill_value=0.0)[:, None, :, None]
+    q = q.at[flat_phys].set(
+        jnp.round(pages_q.astype(jnp.float32) * r).astype(jnp.int8),
+        mode="drop")
+    # 3) quantize the new rows at the new step and scatter them in
+    s_tok = jnp.take(new_scales, phys, axis=0,
+                     mode="fill", fill_value=0.0)              # [S, t, h]
+    rows = jnp.round(newf / jnp.maximum(s_tok[..., None], 1e-30))
+    rows = jnp.clip(rows, -127, 127).astype(jnp.int8)
+    q = q.at[phys, off].set(rows, mode="drop")
+    return (q, new_scales)
+
+
 def paged_gather(pool, page_table):
     """Gather each lane's pages into a contiguous view.
 
-    pool: ``[num_pages, page_size, h, d]``; page_table: ``[S, P]`` →
-    ``[S, P·page_size, h, d]``. Sentinel entries read as zeros (masked by
-    the causal window in :func:`paged_attention` anyway).
+    pool: ``[num_pages, page_size, h, d]`` (or the int8
+    ``(q, scales)`` tuple — dequantized here, the one place reads
+    happen); page_table: ``[S, P]`` → ``[S, P·page_size, h, d]``.
+    Sentinel entries read as zeros (masked by the causal window in
+    :func:`paged_attention` anyway).
     """
+    if isinstance(pool, tuple):
+        q, scales = pool
+        g = jnp.take(q, page_table, axis=0, mode="fill", fill_value=0)
+        sc = jnp.take(scales, page_table, axis=0,
+                      mode="fill", fill_value=0.0)            # [S, P, h]
+        g = g.astype(jnp.float32) * sc[:, :, None, :, None]
+        s, p, page_size, h, d = g.shape
+        return g.reshape(s, p * page_size, h, d)
     g = jnp.take(pool, page_table, axis=0, mode="fill", fill_value=0)
     s, p, page_size, h, d = g.shape
     return g.reshape(s, p * page_size, h, d)

@@ -132,7 +132,7 @@ class DecodeRequest:
                  "deadline", "rng", "tokens", "finish_reason", "error",
                  "event", "t_submit", "t_admit", "t_first_token",
                  "t_done", "top_k", "top_p", "span", "queue_span",
-                 "ttft_breakdown")
+                 "ttft_breakdown", "prefix_covered_tokens")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float, eos_id: Optional[int],
@@ -157,6 +157,9 @@ class DecodeRequest:
         self.span = None            # request-root tracing span
         self.queue_span = None      # child span covering queue wait
         self.ttft_breakdown: Optional[Dict[str, float]] = None
+        # prompt tokens covered by a prefix-cache hit at admission
+        # (0 = miss or caching disabled) — stamped by the scheduler
+        self.prefix_covered_tokens = 0
 
     @property
     def done(self) -> bool:
@@ -174,7 +177,7 @@ _PREFILL, _DECODE = "prefill", "decode"
 
 class _Sequence:
     __slots__ = ("req", "lane", "state", "cursor", "last_token",
-                 "prefill_s", "compile_s")
+                 "prefill_s", "compile_s", "covered")
 
     def __init__(self, req: DecodeRequest, lane: int):
         self.req = req
@@ -184,6 +187,9 @@ class _Sequence:
         self.last_token = 0          # next token to feed in decode
         self.prefill_s = 0.0         # own prefill dispatch wall (TTFT)
         self.compile_s = 0.0         # compile wall its ticks paid
+        self.covered = 0             # positions below this are cache-hit
+        #                              (their K/V is resident: fed tokens
+        #                              there re-attend but never write)
 
 
 class PagedDecodeEngine:
@@ -200,7 +206,9 @@ class PagedDecodeEngine:
                  pages_per_seq: int = 8, num_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  registry: Optional[_metrics.MetricsRegistry] = None,
-                 block_len: int = 1, draft_net=None, draft_k: int = 4):
+                 block_len: int = 1, draft_net=None, draft_k: int = 4,
+                 prefix_cache: bool = False,
+                 kv_dtype: Optional[str] = None):
         import jax.numpy as jnp
         self._validate_net(net)
         self.net = net
@@ -238,10 +246,14 @@ class PagedDecodeEngine:
             dims[name] = (layer.n_heads, layer.n_in // layer.n_heads)
         # same dtype rule as the dense streaming cache (_zero_state):
         # at least f32, so bf16 compute policies keep exact K/V
+        # (kv_dtype="int8" replaces the pools with quantized
+        # (codes, scales) tuples — dtype then only names the fp fallback)
         dtype = jnp.promote_types(net.policy.compute_dtype, jnp.float32)
         self.arena = PagedKVArena(dims, num_pages=int(num_pages),
                                   page_size=self.page_size, dtype=dtype,
-                                  registry=self.registry)
+                                  registry=self.registry,
+                                  kv_dtype=kv_dtype,
+                                  prefix_cache=bool(prefix_cache))
         self.vocab = self._embed_vocab(net)
         # speculative decoding: the draft model's K/V lives in a
         # pools-only SHADOW arena indexed by the same page tables (one
@@ -277,7 +289,7 @@ class PagedDecodeEngine:
                                        jnp.float32)
             self.draft_arena = PagedKVArena(
                 ddims, num_pages=int(num_pages), page_size=self.page_size,
-                dtype=ddtype, with_allocator=False)
+                dtype=ddtype, with_allocator=False, kv_dtype=kv_dtype)
         # per-lane host state
         s, p = self.lanes, self.pages_per_seq
         self._tables = np.full((s, p), self.arena.sentinel, np.int32)
@@ -285,8 +297,19 @@ class PagedDecodeEngine:
         self._base = np.zeros(s, np.int64)      # evicted positions
         self._held: List[List[int]] = [[] for _ in range(s)]
         self._reserve_left = np.zeros(s, np.int64)
+        self._covered = np.zeros(s, np.int64)   # prefix-hit tokens/lane
         self._free_lanes = deque(range(s))
         self._jit_cache: Dict[str, object] = {}
+        # prefix-cache observability (the allocator owns the page-level
+        # gauge/histogram; admission-level outcomes live here)
+        self._m_prefix_hits = self.registry.counter(
+            "kv_prefix_hits_total",
+            "Prefix-cache admission outcomes: full (whole prompt "
+            "resident), partial (some full-page prefix resident), miss",
+            ("result",))
+        self._m_prefix_pages = self.registry.counter(
+            "kv_prefix_hit_pages_total",
+            "KV pages mapped from the prefix cache instead of prefilled")
         # host-round-trip accounting (the satellite the fused loop is
         # measured by): every dispatch that synchronizes the host bumps
         # the sync counter and lands in the "dispatch" component of the
@@ -384,31 +407,95 @@ class PagedDecodeEngine:
 
     # -- lane lifecycle ------------------------------------------------
 
-    def acquire_lane(self, total_tokens: int) -> Optional[int]:
-        """Admission: a free lane + a worst-case page reservation
-        (``min(pages_per_seq, pages(total_tokens))`` — window-capped), or
-        None when either is unavailable (the request stays queued)."""
+    def acquire_lane(self, total_tokens: int,
+                     prompt=None) -> Optional[int]:
+        """Admission: a free lane + a worst-case page reservation, or
+        None when either is unavailable (the request stays queued).
+
+        With the prefix cache enabled and ``prompt`` given, the longest
+        resident full-page prefix is mapped (retained) into the lane's
+        table — those pages skip prefill entirely — and the reservation
+        covers only the UNCOVERED pages. Sequences that will outgrow the
+        window still reserve the full ``pages_per_seq``: every shared
+        page they map may later detach copy-on-write, which draws a
+        private replacement. A fully covered prompt re-feeds its LAST
+        token with a dropped write (the K/V is already resident; the
+        re-feed only produces the first-token distribution), so the
+        feed cursor starts at ``len(prompt) - 1``."""
         if not self._free_lanes:
             return None
-        need = min(self.pages_per_seq, self.arena.pages_for(total_tokens))
-        if not self.arena.allocator.reserve(need):
-            return None
+        alloc = self.arena.allocator
+        index = self.arena.prefix_index
+        worst = self.arena.pages_for(total_tokens)
+        ps = self.page_size
+        covered_pages: List[int] = []
+        if index is not None and prompt is not None:
+            # lookup + admit under one lock: a page the lookup returned
+            # cannot be reclaimed before admit() pins it
+            with alloc._lock:
+                covered_pages = index.lookup(prompt, self.pages_per_seq)
+                if worst > self.pages_per_seq:
+                    need = self.pages_per_seq      # CoW detaches may draw
+                else:
+                    need = worst - len(covered_pages)
+                if not alloc.admit(need, covered_pages):
+                    return None
+        else:
+            need = min(self.pages_per_seq, worst)
+            if not alloc.reserve(need):
+                return None
         lane = self._free_lanes.popleft()
-        self._pos[lane] = 0
+        cov = len(covered_pages)
+        covered_tokens = cov * ps
         self._base[lane] = 0
         self._reserve_left[lane] = need
-        self._held[lane] = []
+        self._held[lane] = list(covered_pages)
         self._tables[lane, :] = self.arena.sentinel
+        if cov:
+            self._tables[lane, :cov] = covered_pages
+        self._covered[lane] = covered_tokens
+        # feed resumes after the covered prefix; a full cover re-feeds
+        # the last prompt token (write dropped) for its distribution
+        if prompt is not None and covered_tokens >= len(prompt):
+            self._pos[lane] = len(prompt) - 1
+        else:
+            self._pos[lane] = covered_tokens
+        if index is not None and prompt is not None:
+            if covered_tokens == 0:
+                self._m_prefix_hits.inc(result="miss")
+            elif covered_tokens >= len(prompt):
+                self._m_prefix_hits.inc(result="full")
+            else:
+                self._m_prefix_hits.inc(result="partial")
+            if cov:
+                self._m_prefix_pages.inc(cov)
         return lane
 
+    def register_prefix(self, lane: int, prompt_ids) -> int:
+        """Publish a freshly prefilled lane's full-page prompt prefix to
+        the index (no-op without one, or if the lane's window already
+        slid — its leading pages no longer hold the prompt's start).
+        Called by the scheduler the moment prefill completes, while the
+        lane still holds its pages."""
+        index = self.arena.prefix_index
+        if index is None or self._base[lane] != 0:
+            return 0
+        full = min(len(prompt_ids) // self.page_size, self.pages_per_seq)
+        if full <= 0:
+            return 0
+        return index.register(prompt_ids, self._held[lane][:full])
+
     def release_lane(self, lane: int) -> None:
-        """Retirement: pages back to the free list, unused reservation
+        """Retirement: the lane's page references released (a page
+        returns to the free list at refcount 0 — prefix-cached pages
+        stay resident under the index's reference), unused reservation
         returned, the lane reusable by the next admission."""
         self.arena.allocator.free(self._held[lane])
         if self._reserve_left[lane]:
             self.arena.allocator.unreserve(int(self._reserve_left[lane]))
         self._held[lane] = []
         self._reserve_left[lane] = 0
+        self._covered[lane] = 0
         self._tables[lane, :] = self.arena.sentinel
         self._pos[lane] = 0
         self._base[lane] = 0
@@ -426,6 +513,8 @@ class PagedDecodeEngine:
         pos, base = int(self._pos[lane]), int(self._base[lane])
         ps = self.page_size
         held = self._held[lane]
+        alloc = self.arena.allocator
+        fresh: List[int] = []      # newly drawn pages (stale content)
         while pos + n_new - 1 - base >= self.window:
             # sliding window at page granularity: the oldest page is
             # recycled as the LAST LIVE table entry. Only the live
@@ -435,19 +524,55 @@ class PagedDecodeEngine:
             # page's stale slots are either overwritten by this chunk
             # or sit beyond the causal mask until they are.
             oldest = held.pop(0)
-            held.append(oldest)
+            if alloc.refcount(oldest) > 1:
+                # COPY-ON-WRITE detach: the oldest page is shared (the
+                # prefix index and/or another lane still reads it) —
+                # recycling it in place would overwrite their K/V.
+                # Sharing is full-page only and tails re-prefill from
+                # the page boundary, so no content copy is ever needed:
+                # release our reference and draw a private tail instead
+                # (admission reserved pages_per_seq for window-sliding
+                # sequences precisely so these draws cannot fail).
+                alloc.free([oldest])
+                replacement = alloc.draw()
+                self._reserve_left[lane] -= 1
+                fresh.append(replacement)
+                alloc.note_cow()
+            else:
+                replacement = oldest
+                fresh.append(oldest)   # its rows are all pre-window now
+            held.append(replacement)
             n = len(held)
             self._tables[lane, :n - 1] = self._tables[lane, 1:n]
-            self._tables[lane, n - 1] = oldest
+            self._tables[lane, n - 1] = replacement
             base += ps
-            self.arena.allocator.note_eviction()
+            alloc.note_eviction()
         last_idx = (pos + n_new - 1 - base) // ps
         while len(held) <= last_idx:
-            page = self.arena.allocator.draw()
+            page = alloc.draw()
             self._reserve_left[lane] -= 1
             self._tables[lane, len(held)] = page
             held.append(page)
+            fresh.append(page)
         self._base[lane] = base
+        if fresh:
+            self._reset_page_scales(fresh)
+
+    def _reset_page_scales(self, pages: List[int]) -> None:
+        """int8 arenas: zero the quantization scales of freshly drawn
+        pages. A recycled page's scale is a max over its PREVIOUS
+        owner's rows — folding new writes into it would quantize them
+        needlessly coarsely, and stale codes × zero scale dequantize to
+        exact zeros (fp pools get the same hygiene from the causal
+        mask). Host-side eager updates on the small ``[num_pages, h]``
+        scale arrays, between dispatches, under the scheduler's tick."""
+        idx = np.asarray(pages, np.int32)
+        for arena in (self.arena, self.draft_arena):
+            if arena is None or arena.kv_dtype != "int8":
+                continue
+            for pools in (arena.k_pools, arena.v_pools):
+                for i, (q, s) in enumerate(pools):
+                    pools[i] = (q, s.at[idx].set(0.0))
 
     def advance(self, lane: int, n: int) -> None:
         """Account ``n`` tokens written by the dispatch that just ran."""
@@ -525,6 +650,10 @@ class PagedDecodeEngine:
         self.arena.reset_pools()
         if self.draft_arena is not None:
             self.draft_arena.reset_pools()
+        if self.arena.prefix_index is not None:
+            # the cached chains point into pools that just became zeros —
+            # serving a hit from them would read garbage
+            self.arena.prefix_index.flush()
 
     def _compile_wall(self) -> float:
         """Total compile wall this engine's registry has seen — deltas
@@ -676,6 +805,19 @@ class PagedDecodeEngine:
             inactive = np.zeros(b, bool)
             zeros_f = np.zeros(b, np.float32)
             zeros_i = np.zeros(b, np.int32)
+            if self.arena.prefix_index is not None and c > 1:
+                # prefix-cache hit ticks re-feed at t=1 (the scheduler
+                # collapses an all-≤1-token prefill tick to the decode
+                # shape) — compile it in every mode or the first hit
+                # pays a mid-serve trace
+                self.run(np.zeros((b, 1), np.int32),
+                         np.full((b, 1), -1, np.int32),
+                         np.zeros(b, np.int32), sentinel_tables)
+                if self.draft_net is not None:
+                    self.run_draft_prefill(np.zeros((b, 1), np.int32),
+                                           np.full((b, 1), -1, np.int32),
+                                           np.zeros(b, np.int32),
+                                           sentinel_tables)
             if self.draft_net is not None:
                 self.run_draft_prefill(np.zeros((b, c), np.int32),
                                        np.full((b, c), -1, np.int32),
@@ -726,6 +868,10 @@ class PagedDecodeEngine:
             raise ValueError("model swap with different parameter shapes")
         self.net = net
         self._jit_cache.clear()
+        if self.arena.prefix_index is not None:
+            # cached K/V was computed by the OLD params — a post-swap
+            # prefix hit would silently decode against the wrong model
+            self.arena.prefix_index.flush()
         # recompile the trace ladder NOW, while the caller holds the
         # fence — otherwise the first post-swap requests pay per-bucket
         # compilation inside the decode loop with their deadlines burning
@@ -993,7 +1139,7 @@ class DecodeScheduler:
                     break
                 req = self._queue[0]
             lane = self.engine.acquire_lane(
-                len(req.prompt) + req.max_new_tokens)
+                len(req.prompt) + req.max_new_tokens, prompt=req.prompt)
             if lane is None:          # no lane / page pressure: stay queued
                 break
             with self._cond:
@@ -1003,7 +1149,14 @@ class DecodeScheduler:
                 req.queue_span.set_attribute("lane", lane)
                 req.queue_span.end()
                 req.queue_span = None
-            self._active[lane] = _Sequence(req, lane)
+            seq = _Sequence(req, lane)
+            # prefix-cache hit: the engine parked the feed cursor past
+            # the covered tokens (a full cover re-feeds the last prompt
+            # token with its write dropped)
+            seq.cursor = int(self.engine._pos[lane])
+            seq.covered = int(self.engine._covered[lane])
+            req.prefix_covered_tokens = min(seq.covered, len(req.prompt))
+            self._active[lane] = seq
             self._m_admitted.inc()
             admitted = True
         return admitted
@@ -1040,12 +1193,25 @@ class DecodeScheduler:
             n = min(c, len(seq.req.prompt) - seq.cursor)
             eng.ensure_pages(seq.lane, n)
             chunk_len.append(n)
-        ids, wslots, rel, tables = self._compact(seqs, c)
+        # prefix-cache fast path: when every admitting lane has at most
+        # one token left to feed (the full-hit re-feed), dispatch at the
+        # t=1 decode shape instead of the padded prefill chunk — hit
+        # TTFT collapses to one decode-step cost (warmup compiles [b,1]
+        # in every mode when the cache is on, so the retrace pin holds)
+        t_feed = (1 if (eng.arena.prefix_index is not None
+                        and max(chunk_len) <= 1) else c)
+        ids, wslots, rel, tables = self._compact(seqs, t_feed)
         for i, seq in enumerate(seqs):
             n = chunk_len[i]
             r = eng.rel_pos(seq.lane)
             ids[i, :n] = seq.req.prompt[seq.cursor:seq.cursor + n]
-            wslots[i, :n] = r + np.arange(n)
+            slots = r + np.arange(n)
+            if seq.covered > seq.cursor:
+                # covered positions are cache-resident: re-fed tokens
+                # there attend (their K/V is in the gathered view) but
+                # must NOT write — a write would touch a shared page
+                slots[:seq.covered - seq.cursor] = -1
+            wslots[i, :n] = slots
             rel[i] = r
         _faults.check("serving.decode_step",
                       {"phase": "prefill", "lanes": len(seqs)})
@@ -1075,6 +1241,10 @@ class DecodeScheduler:
             eng.advance(seq.lane, n)
             seq.cursor += n
             if seq.cursor == len(seq.req.prompt):
+                # publish the prompt's full-page prefix to the cache
+                # BEFORE emitting (emit may retire the lane and release
+                # its pages); a hit re-registers only as an LRU touch
+                eng.register_prefix(seq.lane, seq.req.prompt)
                 # the last prompt position's distribution yields the
                 # FIRST generated token (TTFT lands here)
                 self._emit_token(seq, probs[i, n - 1])

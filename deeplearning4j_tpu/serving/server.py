@@ -160,6 +160,10 @@ class InferenceServer:
                             ("max_queue", "default_max_new_tokens",
                              "request_timeout_s", "start_thread")
                             if k in cfg}
+                # cross-request prefix caching is on by default for
+                # served engines (production traffic repeats system
+                # prompts); pass prefix_cache=False to opt out
+                cfg.setdefault("prefix_cache", True)
                 engine = PagedDecodeEngine(model, registry=self.registry,
                                            **cfg)
                 # compile the whole bucket ladder before the loop starts:
@@ -402,6 +406,25 @@ class InferenceServer:
         if self.decode is not None:
             h["decode"] = {"active": self.decode.active_count(),
                            "queued": self.decode.queue_depth()}
+            eng = self.decode.engine
+            index = eng.arena.prefix_index
+            if index is not None:
+                hits = self.registry.get("kv_prefix_hits_total")
+                hit_pages = self.registry.get("kv_prefix_hit_pages_total")
+                alloc = eng.arena.allocator
+                h["decode"]["prefix_cache"] = {
+                    "hits_full": (hits.value(result="full")
+                                  if hits else 0.0),
+                    "hits_partial": (hits.value(result="partial")
+                                     if hits else 0.0),
+                    "misses": (hits.value(result="miss")
+                               if hits else 0.0),
+                    "hit_pages": (hit_pages.value()
+                                  if hit_pages else 0.0),
+                    "cached_pages": index.cached_pages,
+                    "shared_pages": alloc.shared_pages,
+                    "kv_dtype": eng.arena.kv_dtype or "fp",
+                }
         return h
 
     def _generate(self, payload: dict, trace_ctx: Optional[str] = None

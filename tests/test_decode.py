@@ -670,7 +670,14 @@ class TestServingGenerateHTTP:
         assert out["ttft_ms"] >= 0
         health = json.loads(urllib.request.urlopen(
             base + "/healthz", timeout=5).read())
-        assert health["decode"] == {"active": 0, "queued": 0}
+        assert health["decode"]["active"] == 0
+        assert health["decode"]["queued"] == 0
+        # served engines default prefix_cache=True → the healthz pane
+        # carries the prefix-cache observability block (ISSUE 19)
+        pane = health["decode"]["prefix_cache"]
+        assert pane["misses"] >= 1              # the 4-token prompt above
+        assert pane["cached_pages"] == 0        # sub-page prompt: nothing
+        #                                         full-page to publish
         metrics = urllib.request.urlopen(
             base + "/metrics", timeout=5).read().decode()
         assert "decode_batch_occupancy" in metrics

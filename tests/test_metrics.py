@@ -971,6 +971,8 @@ _LABEL_LINT = re.compile(r"^[a-z][a-z0-9_]*$")
 _UNITLESS_HISTOGRAMS = {
     "serving_batch_size",           # examples per coalesced model call
     "decode_batch_occupancy",       # lanes active per decode step
+    "kv_page_refcount",             # owners per shared KV page (a count
+    #                                 distribution, observed per retain)
 }
 _UNIT_SUFFIXES = ("_seconds", "_bytes")
 # reserved by the Prometheus exposition itself
@@ -1057,11 +1059,19 @@ class TestMetricsConventions:
         net = ComputationGraph(transformer_lm(
             8, n_layers=1, d_model=8, n_heads=1, d_ff=16, seed=3,
             input_ids=True, max_cache_t=16)).init()
+        # prefix_cache + int8 so the prefix-caching families (hit
+        # outcomes, shared-page gauge, refcount histogram, CoW counter)
+        # register and lint too (ISSUE 19)
         engine = PagedDecodeEngine(net, max_batch=2, page_size=4,
-                                   pages_per_seq=4, registry=reg)
+                                   pages_per_seq=4, registry=reg,
+                                   prefix_cache=True, kv_dtype="int8")
         sched = DecodeScheduler(engine, registry=reg,
                                 start_thread=False)
         problems = _lint_registry(reg, "representative")
         assert not problems, "\n".join(problems)
         assert reg.get("decode_goodput_tokens_total") is not None
+        for fam in ("kv_prefix_hits_total", "kv_prefix_hit_pages_total",
+                    "kv_pages_shared", "kv_page_refcount",
+                    "kv_pages_cow_total"):
+            assert reg.get(fam) is not None, fam
         assert sched is not None  # keep the weak gauges alive till here

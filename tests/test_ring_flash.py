@@ -17,7 +17,7 @@ from deeplearning4j_tpu.ops.attention import (
 from deeplearning4j_tpu.parallel import create_mesh
 
 
-def _qkv(rng, b=2, t=64, h=2, d=8):
+def _qkv(rng, b=2, t=32, h=2, d=8):
     mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
     return mk(), mk(), mk()
 
@@ -29,7 +29,7 @@ class TestFlashCarry:
         # one hop fed the WHOLE sequence == the monolithic kernel: same
         # out AND same lse (the backward depends on the lse surviving
         # the carry fold exactly)
-        q, k, v = _qkv(rng, t=128, d=16)
+        q, k, v = _qkv(rng, t=64, d=16)
         carry = fa.flash_carry_init(q)
         carry = fa.flash_attention_block(q, k, v, carry, causal=True,
                                          block_q=32, interpret=True)
@@ -49,12 +49,12 @@ class TestFlashCarry:
         # the carry == attending the full sequence at once (the
         # order-independent online-softmax merge, exactly what each ring
         # device computes)
-        q, k, v = _qkv(rng, t=64)
-        qs = q[:, :32]                           # the "local" shard
+        q, k, v = _qkv(rng)
+        qs = q[:, :16]                           # the "local" shard
         carry = fa.flash_carry_init(qs)
-        for sl in (slice(0, 32), slice(32, 64)):
+        for sl in (slice(0, 16), slice(16, 32)):
             carry = fa.flash_attention_block(qs, k[:, sl], v[:, sl], carry,
-                                             causal=False, block_q=32,
+                                             causal=False, block_q=16,
                                              interpret=True)
         out, _ = fa.flash_carry_finalize(carry)
         ref = np.asarray(dot_product_attention(qs, k, v))
@@ -62,14 +62,14 @@ class TestFlashCarry:
                                    atol=2e-5)
 
     def test_hop_length_mismatch_rejected(self, rng):
-        q, k, v = _qkv(rng, t=64)
+        q, k, v = _qkv(rng)
         with pytest.raises(ValueError, match="shard-sized"):
-            fa.flash_attention_block(q, k[:, :32], v[:, :32],
-                                     fa.flash_carry_init(q), block_q=32,
+            fa.flash_attention_block(q, k[:, :16], v[:, :16],
+                                     fa.flash_carry_init(q), block_q=16,
                                      interpret=True)
 
     def test_empty_carry_finalizes_to_zero(self, rng):
-        q, *_ = _qkv(rng, t=32)
+        q, *_ = _qkv(rng, t=16)
         out, lse = fa.flash_carry_finalize(fa.flash_carry_init(q))
         assert np.allclose(np.asarray(out), 0.0)
         assert np.all(np.asarray(lse) <= fa._HALF_NEG)
@@ -77,13 +77,13 @@ class TestFlashCarry:
     def test_bwd_block_sums_to_dense_gradient(self, rng):
         # per-hop (dq, dk, dv) against the GLOBAL lse sum exactly to the
         # dense gradient — the property the ring backward relies on
-        q, k, v = _qkv(rng, t=64)
-        qs = q[:, :32]
+        q, k, v = _qkv(rng)
+        qs = q[:, :16]
         carry = fa.flash_carry_init(qs)
-        halves = [slice(0, 32), slice(32, 64)]
+        halves = [slice(0, 16), slice(16, 32)]
         for sl in halves:
             carry = fa.flash_attention_block(qs, k[:, sl], v[:, sl], carry,
-                                             block_q=32, interpret=True)
+                                             block_q=16, interpret=True)
         out, lse = fa.flash_carry_finalize(carry)
         g = jnp.asarray(rng.normal(size=qs.shape).astype(np.float32))
         dq = np.zeros(qs.shape, np.float32)
@@ -92,7 +92,7 @@ class TestFlashCarry:
         for sl in halves:
             dq_h, dk_h, dv_h = fa.flash_attention_bwd_block(
                 qs, k[:, sl], v[:, sl], out.astype(qs.dtype), lse, g,
-                block_q=32, interpret=True)
+                block_q=16, interpret=True)
             dq += np.asarray(dq_h)
             dk[:, sl] += np.asarray(dk_h)
             dv[:, sl] += np.asarray(dv_h)
@@ -116,10 +116,14 @@ class TestRingFlashParity:
         monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "1")
         mesh = create_mesh({"seq": 4})
         ring = make_ring_attention(mesh, "seq", causal=causal)
-        out = np.asarray(jax.jit(ring)(q, k, v))
-        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
-        g_fl = jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v) ** 2),
-                        argnums=(0, 1, 2))(q, k, v)
+        # forward + grads in ONE jit: the VJP trace contains the forward,
+        # so a separate jit(ring) would compile the same program twice
+        out, g_fl = jax.jit(lambda q, k, v: (
+            ring(q, k, v),
+            jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                                   atol=2e-5)
         for a, b in zip(g_ref, g_fl):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
@@ -127,27 +131,29 @@ class TestRingFlashParity:
     @pytest.mark.parametrize("causal", [False, True])
     def test_non_divisible_t_pads_under_key_mask(self, rng, causal,
                                                  monkeypatch):
-        # t=40 over 4 devices → t_local=10, padded to the flash tile at
+        # t=20 over 4 devices → t_local=5, padded to the flash tile at
         # the END of every shard; padded keys masked, padded query rows
         # sliced — output and grads still match dense exactly
-        q, k, v = _qkv(rng, t=40)
+        q, k, v = _qkv(rng, t=20)
         ref = np.asarray(dot_product_attention(q, k, v, causal=causal))
         g_ref = jax.grad(lambda q: jnp.sum(dot_product_attention(
             q, k, v, causal=causal) ** 2))(q)
         monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "1")
         mesh = create_mesh({"seq": 4})
         ring = make_ring_attention(mesh, "seq", causal=causal)
-        out = np.asarray(jax.jit(ring)(q, k, v))
-        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
-        g_fl = jax.grad(lambda q: jnp.sum(ring(q, k, v) ** 2))(q)
+        out, g_fl = jax.jit(lambda q: (
+            ring(q, k, v),
+            jax.grad(lambda q: jnp.sum(ring(q, k, v) ** 2))(q)))(q)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                                   atol=2e-5)
         np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref),
                                    rtol=2e-4, atol=2e-4)
 
     def test_ragged_key_mask_rides_the_ring(self, rng, monkeypatch):
         q, k, v = _qkv(rng)
-        mask = np.ones((2, 64), np.float32)
-        mask[0, 50:] = 0.0
-        mask[1, 37:] = 0.0
+        mask = np.ones((2, 32), np.float32)
+        mask[0, 25:] = 0.0
+        mask[1, 19:] = 0.0
         mask = jnp.asarray(mask)
         ref = np.asarray(dot_product_attention(q, k, v, causal=True,
                                                mask=mask))
@@ -162,7 +168,7 @@ class TestRingFlashParity:
         # leading padding + causal: query rows with NO attendable key
         # anywhere on the ring finalize to 0 (carry never leaves NEG_INF)
         q, *_ = _qkv(rng)
-        mask = np.ones((2, 64), np.float32)
+        mask = np.ones((2, 32), np.float32)
         mask[:, :9] = 0.0
         monkeypatch.setenv("DL4JTPU_FLASH_ATTENTION", "1")
         mesh = create_mesh({"seq": 4})
