@@ -1349,6 +1349,184 @@ def bench_decode() -> dict:
     return out
 
 
+def bench_fleet() -> dict:
+    """Serving-fleet tier (ISSUE 20): aggregate decode throughput at 1
+    vs 4 routed replicas, plus a rolling ``set_model`` across the
+    4-replica fleet under light load with zero shed increase.
+
+    Honest-measurement note: this harness has ONE CPU core, so raw
+    engine throughput cannot scale with replica count. Per-dispatch
+    DEVICE time is therefore simulated — a FaultPlan hook on the
+    ``serving.decode_step`` seam sleeps ``SIM_STEP_S`` inside every
+    engine dispatch (sleeps release the GIL, so replica engines overlap
+    exactly the way independent accelerators would, while the tiny real
+    model keeps the host path honest). What the scaling number measures
+    is the FLEET tier itself: router pick quality, HTTP proxying,
+    heartbeat/capacity staleness, and scheduler admission — the real
+    end-to-end path a multi-host fleet exercises, minus the chips."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.models import transformer_lm
+    from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+    from deeplearning4j_tpu.parallel.elastic import \
+        InMemoryCoordinationStore
+    from deeplearning4j_tpu.serving import (FleetRouter, InferenceServer,
+                                            ReplicaAgent)
+    from deeplearning4j_tpu.util import faults
+    from deeplearning4j_tpu.util.serialization import save_model
+
+    VOCAB, WINDOW = 32, 32
+    SIM_STEP_S = 0.05           # simulated device time per dispatch
+    MAX_NEW = 16
+    TIMEOUT_S = 120.0
+
+    def _net(seed=7):
+        conf = transformer_lm(VOCAB, n_layers=1, d_model=32, n_heads=2,
+                              d_ff=64, seed=seed, input_ids=True,
+                              max_cache_t=WINDOW)
+        return ComputationGraph(conf).init()
+
+    def _post(port, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=TIMEOUT_S + 10) as r:
+            return json.loads(r.read())
+
+    def build_fleet(n):
+        store = InMemoryCoordinationStore()
+        servers = [InferenceServer(
+            _net(), port=0,
+            decode={"max_batch": 2, "page_size": 8, "pages_per_seq": 4,
+                    "prefill_chunk": 8, "request_timeout_s": TIMEOUT_S})
+            for _ in range(n)]
+        agents = [ReplicaAgent(s, store, replica=f"r{i}",
+                               lease_s=2.0).start()
+                  for i, s in enumerate(servers)]
+        router = FleetRouter(store, lease_s=2.0,
+                             request_timeout_s=TIMEOUT_S,
+                             attempt_timeout_s=TIMEOUT_S)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if router._health()["ready"] == n:
+                break
+            time.sleep(0.05)
+        return store, servers, agents, router
+
+    def teardown(servers, agents, router):
+        router.stop()
+        for a in agents:
+            a.stop(deregister=False)
+        for s in servers:
+            s.stop(drain=False)
+
+    def measure(router, n_requests, concurrency):
+        """Closed-loop: `concurrency` clients drain a shared request
+        counter back-to-back; tokens/s over the whole drain."""
+        it = iter(range(n_requests))
+        lock = threading.Lock()
+        done = {"tokens": 0, "errors": 0}
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                try:
+                    body = _post(router.port,
+                                 {"prompt_ids": [1 + i % 6] * 6,
+                                  "max_new_tokens": MAX_NEW,
+                                  "timeout_s": TIMEOUT_S})
+                    with lock:
+                        done["tokens"] += len(body["tokens"])
+                except Exception:
+                    with lock:
+                        done["errors"] += 1
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return done["tokens"] / wall, done["errors"]
+
+    out = {"sim_step_s": SIM_STEP_S, "max_new_tokens": MAX_NEW}
+    plan = faults.FaultPlan()
+    plan.always("serving.decode_step",
+                exc=lambda payload: time.sleep(SIM_STEP_S))
+
+    # ---- scaling: same closed-loop offered load per replica ----------
+    for n in (1, 4):
+        store, servers, agents, router = build_fleet(n)
+        try:
+            plan.install()
+            try:
+                tps, errors = measure(router, n_requests=24 * n,
+                                      concurrency=6 * n)
+            finally:
+                plan.uninstall()
+            out[f"tokens_per_s_{n}r"] = round(tps, 1)
+            out[f"errors_{n}r"] = errors
+            if n == 4:
+                reqs = router.registry.get("fleet_requests_total")
+                out["router_ok"] = int(reqs.value(outcome="ok"))
+                out["failovers"] = int(router.registry.get(
+                    "fleet_failovers_total").total())
+                # ---- rolling deploy across the 4 replicas under light
+                # load (no sim sleeps: swap_net re-warms in the fence
+                # and the acceptance is zero shed, not speed)
+                shed = router.registry.get("serving_shed_total")
+                shed_before = shed.value(reason="no_replica")
+                with tempfile.TemporaryDirectory() as d:
+                    path = os.path.join(d, "next.zip")
+                    save_model(_net(seed=11), path)
+                    stop = threading.Event()
+                    codes = []
+
+                    def light_load():
+                        i = 0
+                        while not stop.is_set():
+                            i += 1
+                            try:
+                                _post(router.port,
+                                      {"prompt_ids": [1, 2, 3],
+                                       "max_new_tokens": 2,
+                                       "idempotency_key": f"roll-{i}"})
+                                codes.append(200)
+                            except Exception:
+                                codes.append(-1)
+                            time.sleep(0.05)
+                    loader = threading.Thread(target=light_load)
+                    loader.start()
+                    t0 = time.perf_counter()
+                    try:
+                        rolled = router.rolling_set_model(
+                            path, ready_timeout_s=180)
+                    finally:
+                        stop.set()
+                        loader.join(timeout=60)
+                    out["rolling_deploy"] = {
+                        "replicas": len(rolled),
+                        "all_ok": all(r["ok"] for r in rolled),
+                        "seconds": round(time.perf_counter() - t0, 2),
+                        "requests_during_roll": len(codes),
+                        "request_failures": sum(c != 200 for c in codes),
+                        "shed_increase": shed.value(reason="no_replica")
+                                         - shed_before,
+                    }
+        finally:
+            teardown(servers, agents, router)
+    out["fleet_scaling_x"] = round(
+        out["tokens_per_s_4r"] / max(out["tokens_per_s_1r"], 1e-9), 2)
+    return out
+
+
 def main() -> None:
     import jax
     device = str(jax.devices()[0].device_kind)
@@ -1369,6 +1547,7 @@ def main() -> None:
     _run_config(out, "flash_attention", bench_flash_attention)
     tlm_res = _run_config(out, "transformer_lm", bench_transformer_lm)
     decode_res = _run_config(out, "decode", bench_decode)
+    fleet_res = _run_config(out, "fleet", bench_fleet)
 
     # snapshot the process-default metrics registry into the payload so
     # the perf trajectory carries whatever the run recorded (retry
@@ -1433,6 +1612,23 @@ def main() -> None:
             "ttft_p50_ms": decode_res["ttft_p50_ms"],
             "ttft_p99_ms": decode_res["ttft_p99_ms"],
             "tpot_ms": decode_res["tpot_ms"],
+        }
+
+    # fleet-scaling row (ISSUE 20): aggregate routed decode throughput
+    # at 4 replicas over 1 (target >= 3.2x — fleet-tier overhead bounded
+    # at <=20% of linear), plus the rolling-deploy zero-shed evidence;
+    # device time is simulated per-dispatch on this 1-core harness (see
+    # bench_fleet docstring), so the ratio isolates the fleet tier
+    if fleet_res is not None and "fleet_scaling_x" in fleet_res:
+        out["fleet_decode_scaling"] = {
+            "metric": "fleet_decode_scaling",
+            "value": fleet_res["fleet_scaling_x"],
+            "unit": "x_at_4_replicas",
+            "vs_baseline": round(fleet_res["fleet_scaling_x"] / 3.2, 4),
+            "tokens_per_s_1r": fleet_res["tokens_per_s_1r"],
+            "tokens_per_s_4r": fleet_res["tokens_per_s_4r"],
+            "failovers": fleet_res.get("failovers"),
+            "rolling_deploy": fleet_res.get("rolling_deploy"),
         }
 
     # input-pipeline row (ISSUE 14): records/s through the full

@@ -382,6 +382,141 @@ class ElasticConfig:
 
 
 # ----------------------------------------------------------------------
+# lease membership: the reusable liveness layer
+# ----------------------------------------------------------------------
+
+class LeaseMembership:
+    """Heartbeat-lease membership view over a :class:`CoordinationStore`.
+
+    The liveness core of the elastic protocol, factored out so training
+    fleets and serving fleets share one lease discipline. Members publish
+    an overwritten ``<prefix>/<member>.json`` doc stamped with wall-clock
+    ``ts``; any observer derives live/dead from lease age and records
+    join/evict/rejoin transitions into ``membership_transitions_total``
+    plus the flight recorder. Two membership styles:
+
+    - **static** (training): ``members`` is the fleet spec; a spec host
+      that never heartbeats becomes an evict once the join grace expires.
+    - **dynamic** (serving): ``members=None``; the member set is
+      discovered from the store listing, so replicas self-register by
+      publishing their first heartbeat and observers need no fleet spec.
+
+    Timestamps are deliberately ``time.time()`` — they are compared
+    ACROSS processes, where an injected per-process clock has no meaning
+    (see :class:`ElasticConfig`). Tests script failures by killing
+    members, not by warping the clock.
+    """
+
+    def __init__(self, store: CoordinationStore, *, observer: str,
+                 lease_s: float, members: Optional[Sequence[str]] = None,
+                 prefix: str = "hb", join_grace_s: Optional[float] = None,
+                 registry=None, flight_kind: str = "elastic_membership"):
+        if lease_s <= 0:
+            raise ValueError(f"lease_s must be > 0, got {lease_s}")
+        self.store = store
+        self.observer = observer
+        self.lease_s = float(lease_s)
+        self.prefix = prefix.strip("/")
+        self.static_members = (None if members is None
+                               else tuple(members))
+        self.registry = registry
+        self.flight_kind = flight_kind
+        # lease-level view for metrics/attribution: member -> status
+        self._view: Dict[str, str] = {
+            h: "unseen" for h in (self.static_members or ())}
+        # join grace: a member that has NEVER heartbeat is not lease-dead
+        # while processes are still starting up (first compiles run long
+        # before the first publish); it becomes evictable once the grace
+        # from OUR start expires
+        self._born = time.time()
+        self.join_grace_s = (3.0 * self.lease_s if join_grace_s is None
+                             else float(join_grace_s))
+
+    # -- publish side --------------------------------------------------
+
+    def _key(self, member: str) -> str:
+        return f"{self.prefix}/{member}.json"
+
+    def next_incarnation(self, member: str) -> int:
+        doc = self.store.get_json(self._key(member))
+        return (int(doc.get("incarnation", 0)) + 1) if doc else 1
+
+    def publish(self, member: str, doc: dict) -> None:
+        """Write ``member``'s heartbeat doc, stamping wall-clock ``ts``.
+
+        Callers own the heartbeat CADENCE (and, crucially, which thread
+        publishes: liveness must be attested from the loop whose hang
+        should expire the lease)."""
+        body = dict(doc)
+        body.setdefault("host", member)
+        body["ts"] = time.time()
+        self.store.put_json(self._key(member), body, overwrite=True)
+
+    # -- observe side --------------------------------------------------
+
+    def members(self) -> Tuple[str, ...]:
+        """Static spec if given, else every member ever seen in the
+        store. The discovered set only grows — a vanished member
+        transitions to dead via its stale lease, not by key removal."""
+        if self.static_members is not None:
+            return self.static_members
+        seen = set(self._view)
+        plen = len(self.prefix) + 1
+        for key in self.store.list(self.prefix):
+            name = key[plen:]
+            if name.endswith(".json"):
+                seen.add(name[: -len(".json")])
+        return tuple(sorted(seen))
+
+    def view(self) -> Dict[str, dict]:
+        """Refresh the lease-level view; records join/evict/rejoin
+        transitions into metrics + the flight recorder. Each member's
+        entry carries ``alive``/``done``/``round``/``incarnation``/
+        ``age_s`` plus the raw heartbeat ``doc`` (serving members
+        advertise capacity and readiness there)."""
+        now = time.time()
+        out: Dict[str, dict] = {}
+        for h in self.members():
+            doc = self.store.get_json(self._key(h)) or {}
+            ts = float(doc.get("ts", -1e18))
+            done = doc.get("status") == "done"
+            alive = done or (now - ts) <= self.lease_s
+            in_grace = (now - self._born) <= self.join_grace_s
+            if not doc and in_grace:
+                alive = True        # starting up (first compile)
+            out[h] = {"alive": alive, "done": done,
+                      "round": int(doc.get("round", -1)),
+                      "incarnation": int(doc.get("incarnation", 0)),
+                      "age_s": None if not doc else now - ts,
+                      "doc": doc}
+            prev = self._view.get(h, "unseen")
+            # a never-heartbeat host stays "unseen" through the grace
+            # (no spurious join), then turns dead — so a host that never
+            # came up reports as an evict, not as a silent unseen
+            new = ("done" if done
+                   else "live" if doc and alive
+                   else "dead" if doc or not in_grace
+                   else "unseen")
+            if new != prev:
+                self._view[h] = new
+                event = None
+                if prev == "unseen" and new in ("live", "done"):
+                    event = "join"
+                elif prev in ("live", "done", "unseen") and new == "dead":
+                    event = "evict"
+                elif prev == "dead" and new in ("live", "done"):
+                    event = "rejoin"
+                if event is not None:
+                    transitions_counter(self.registry).inc(
+                        event=event, host=h)
+                    _flight.record(self.flight_kind, event=event,
+                                   host=h, observer=self.observer,
+                                   incarnation=out[h]["incarnation"],
+                                   peer_round=out[h]["round"])
+        return out
+
+
+# ----------------------------------------------------------------------
 # coordinator: heartbeats, membership log, round ledger
 # ----------------------------------------------------------------------
 
@@ -407,23 +542,14 @@ class ElasticCoordinator:
         self.cfg = cfg
         self.registry = registry
         self.host = cfg.host
-        self.incarnation = self._next_incarnation()
-        # lease-level view for metrics/attribution: host -> status
-        self._view: Dict[str, str] = {h: "unseen" for h in cfg.fleet}
+        self.membership = LeaseMembership(
+            store, observer=cfg.host, lease_s=cfg.lease_s,
+            members=cfg.fleet, registry=registry)
+        self.incarnation = self.membership.next_incarnation(cfg.host)
         self._last_hb = -1e18
-        # join grace: a fleet-spec host that has NEVER heartbeat is not
-        # lease-dead while processes are still starting up (first-round
-        # compiles run long before the first publish); it becomes
-        # evictable once the grace from OUR start expires
-        self._born = time.time()
-        self.join_grace_s = 3.0 * float(cfg.lease_s)
         self._log_cache: Optional[Tuple[Tuple[str, ...], List[dict]]] = None
 
     # -- heartbeats ----------------------------------------------------
-
-    def _next_incarnation(self) -> int:
-        doc = self.store.get_json(f"hb/{self.cfg.host}.json")
-        return (int(doc.get("incarnation", 0)) + 1) if doc else 1
 
     def heartbeat(self, round_: int, status: str = "live", *,
                   force: bool = False) -> None:
@@ -433,54 +559,14 @@ class ElasticCoordinator:
         if not force and now - self._last_hb < self.cfg.heartbeat_every_s:
             return
         self._last_hb = now
-        self.store.put_json(
-            f"hb/{self.host}.json",
-            {"host": self.host, "incarnation": self.incarnation,
-             "round": int(round_), "status": status, "ts": now},
-            overwrite=True)
+        self.membership.publish(self.host, {
+            "host": self.host, "incarnation": self.incarnation,
+            "round": int(round_), "status": status})
 
     def fleet_view(self) -> Dict[str, dict]:
         """Refresh the lease-level view; records join/evict/rejoin
         transitions into metrics + the flight recorder."""
-        now = time.time()
-        out: Dict[str, dict] = {}
-        for h in self.cfg.fleet:
-            doc = self.store.get_json(f"hb/{h}.json") or {}
-            ts = float(doc.get("ts", -1e18))
-            done = doc.get("status") == "done"
-            alive = done or (now - ts) <= self.cfg.lease_s
-            in_grace = (now - self._born) <= self.join_grace_s
-            if not doc and in_grace:
-                alive = True        # starting up (first-round compile)
-            out[h] = {"alive": alive, "done": done,
-                      "round": int(doc.get("round", -1)),
-                      "incarnation": int(doc.get("incarnation", 0)),
-                      "age_s": None if not doc else now - ts}
-            prev = self._view[h]
-            # a never-heartbeat host stays "unseen" through the grace
-            # (no spurious join), then turns dead — so a host that never
-            # came up reports as an evict, not as a silent unseen
-            new = ("done" if done
-                   else "live" if doc and alive
-                   else "dead" if doc or not in_grace
-                   else "unseen")
-            if new != prev:
-                self._view[h] = new
-                event = None
-                if prev == "unseen" and new in ("live", "done"):
-                    event = "join"
-                elif prev in ("live", "done", "unseen") and new == "dead":
-                    event = "evict"
-                elif prev == "dead" and new in ("live", "done"):
-                    event = "rejoin"
-                if event is not None:
-                    transitions_counter(self.registry).inc(
-                        event=event, host=h)
-                    _flight.record("elastic_membership", event=event,
-                                   host=h, observer=self.host,
-                                   incarnation=out[h]["incarnation"],
-                                   peer_round=out[h]["round"])
-        return out
+        return self.membership.view()
 
     # -- membership log (round math) -----------------------------------
 

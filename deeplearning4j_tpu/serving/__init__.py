@@ -10,9 +10,11 @@ dispatch and keep the MXU fed) and hot model swap.
 
 from .decode import (DecodeRequest, DecodeScheduler, PagedDecodeEngine,
                      SchedulerDraining, SchedulerSaturated)
+from .fleet import FleetRouter, ReplicaAgent
 from .kv_cache import PagedKVArena, PageAllocator
 from .server import InferenceServer
 
 __all__ = ["InferenceServer", "PagedDecodeEngine", "DecodeScheduler",
            "DecodeRequest", "PagedKVArena", "PageAllocator",
-           "SchedulerSaturated", "SchedulerDraining"]
+           "SchedulerSaturated", "SchedulerDraining",
+           "FleetRouter", "ReplicaAgent"]

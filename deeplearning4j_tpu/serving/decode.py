@@ -165,6 +165,15 @@ class DecodeRequest:
     def done(self) -> bool:
         return self.finish_reason is not None
 
+    @property
+    def retryable(self) -> bool:
+        """True when the request died with the ENGINE (pools rebuilt
+        after a dispatch failure, server shutting down) rather than on
+        its own terms — safe to replay elsewhere because no terminal
+        answer was produced and any partial ``tokens`` are preserved.
+        This is the contract a fleet router's idempotent replay rides."""
+        return self.finish_reason in ("error", "shutdown")
+
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request finishes (True) or ``timeout`` real
         seconds pass (False)."""

@@ -8,10 +8,19 @@ Endpoints:
                      deadline", "ttft_ms": ..., "n_generated": N}
                   (requires ``decode=`` — the continuous-batching
                   scheduler over the paged KV arena, serving/decode.py)
-  GET  /healthz   {"ok": true, "model": "...", "served": N,
+  GET  /healthz   {"ok": true, "live": true, "ready": true,
+                   "ready_reasons": [], "model": "...", "served": N,
                    "queue_depth": n, "queue_capacity": n,
                    "breaker": "closed|open|half_open", "draining": bool,
+                   "model_digest": "...", "model_generation": n,
                    "decode": {"active": n, "queued": n} when enabled}
+  GET  /livez     200 {"live": true} while the process can still answer
+                  (the batcher loop is up); the *process-restart* signal
+  GET  /readyz    200 {"ready": true} only when the replica should be
+                  admitted traffic; 503 + the gating reasons while it is
+                  draining, fencing for set_model, warming up, or its
+                  breaker is open — the *route-around* signal. /healthz
+                  historically conflated the two; it now carries both
   GET  /metrics   Prometheus text exposition of this server's registry
   GET  /debug/flightrecorder
                   the process flight recorder's current event ring as
@@ -101,6 +110,21 @@ class ModelSwapRefused(RuntimeError):
     retriable after drain (HTTP 409 on the /model endpoint)."""
 
 
+def drain_counter(registry=None) -> _metrics.Counter:
+    """``serving_drain_total{result}`` — graceful drains by outcome.
+
+    ``result="ok"`` when everything admitted was answered within the
+    timeout; ``result="timeout"`` for the half-drained state, which also
+    emits a ``serving_drain_timeout`` flight-recorder event naming the
+    requests still in flight."""
+    reg = registry if registry is not None else _metrics.REGISTRY
+    return reg.counter(
+        "serving_drain_total",
+        "Graceful drains by result (ok = fully drained within the "
+        "timeout; timeout = half-drained, detailed by the "
+        "serving_drain_timeout flight event)", ("result",))
+
+
 class _Pending:
     __slots__ = ("x", "event", "result", "error", "code", "deadline",
                  "enqueued_at", "span", "queue_span")
@@ -128,7 +152,8 @@ class InferenceServer:
                  breaker: Optional[CircuitBreaker] = None,
                  clock: Clock = SYSTEM_CLOCK,
                  registry: Optional[_metrics.MetricsRegistry] = None,
-                 tracer=None, decode=None):
+                 tracer=None, decode=None,
+                 warmup_background: bool = False):
         self._model = model
         self.max_batch = int(max_batch)
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
@@ -146,6 +171,13 @@ class InferenceServer:
             failure_threshold=3, reset_timeout_s=5.0, clock=clock,
             name="serving-model")
         self._chain_breaker_hook()
+        # readiness state (distinct from liveness): warming / swapping /
+        # draining each gate admission without implying the process is
+        # unhealthy — see /readyz vs /livez
+        self._warming = False
+        self._swapping = False
+        self._model_generation = 0
+        self._model_digest: Optional[str] = None
         # continuous-batched generative decode (serving/decode.py):
         # pass a prebuilt DecodeScheduler, or a dict of engine/scheduler
         # kwargs to build one over THIS model and THIS registry
@@ -169,10 +201,28 @@ class InferenceServer:
                 # compile the whole bucket ladder before the loop starts:
                 # server START pays it, not the first live requests'
                 # SLO deadlines
-                engine.warmup()
+                if not warmup_background:
+                    engine.warmup()
                 self.decode = DecodeScheduler(
                     engine, clock=clock, registry=self.registry,
                     tracer=tracer, **sched_kw)
+                if warmup_background:
+                    # fleet replicas warm AFTER the HTTP server is up so
+                    # they can register and report ready=false while the
+                    # bucket ladder compiles; the dispatch lock is held
+                    # so scheduler ticks (and the set_model fence) queue
+                    # behind the warmup instead of racing its dispatches
+                    self._warming = True
+
+                    def _warm(sched=self.decode, eng=engine):
+                        try:
+                            with sched._dispatch_lock:
+                                eng.warmup()
+                        finally:
+                            self._warming = False
+
+                    threading.Thread(target=_warm, daemon=True,
+                                     name="serving-warmup").start()
         self._queue: "queue.Queue[_Pending]" = queue.Queue(
             maxsize=int(max_queue))
         self._lock = threading.Lock()
@@ -219,6 +269,14 @@ class InferenceServer:
                 path = url.path
                 if path == "/healthz":
                     self._json(outer._health())
+                elif path == "/livez":
+                    live = outer.live
+                    self._json({"live": live}, 200 if live else 503)
+                elif path == "/readyz":
+                    reasons = outer.readiness_reasons()
+                    self._json({"ready": not reasons,
+                                "reasons": reasons},
+                               200 if not reasons else 503)
                 elif path == "/metrics":
                     _metrics.write_exposition(self, outer.registry)
                     outer._m_responses.inc(code="200")
@@ -334,6 +392,7 @@ class InferenceServer:
         self._m_deadline_expired = reg.counter(
             "serving_deadline_expired_total",
             "Queued requests answered 504 after their deadline passed")
+        self._m_drain = drain_counter(reg)
         self._m_served = reg.counter(
             "serving_examples_served_total",
             "Examples answered 200 through the batched model call")
@@ -392,11 +451,67 @@ class InferenceServer:
                    + self._m_shed.value(reason="draining"))
 
     # ------------------------------------------------------------------
+    # liveness vs readiness (the /healthz split)
+    # ------------------------------------------------------------------
+
+    @property
+    def live(self) -> bool:
+        """Process-level liveness: the serving loops are up. False means
+        restart the replica; it says nothing about routability."""
+        return not self._stop.is_set() and self._batcher.is_alive()
+
+    def readiness_reasons(self) -> List[str]:
+        """Why this replica should NOT be admitted traffic right now
+        (empty = ready). Draining, fencing for ``set_model``, warming the
+        decode ladder, and an open breaker all gate admission WITHOUT
+        implying the process is unhealthy — a router (or LB) routes
+        around a not-ready replica instead of shedding at it."""
+        reasons = []
+        if self._warming:
+            reasons.append("warming")
+        if self._draining:
+            reasons.append("draining")
+        if self._swapping:
+            reasons.append("model_swap")
+        if self._stop.is_set():
+            reasons.append("stopped")
+        if self.breaker.state == "open":
+            reasons.append("breaker_open")
+        return reasons
+
+    @property
+    def ready(self) -> bool:
+        return not self.readiness_reasons()
+
+    @property
+    def model_digest(self) -> str:
+        """Content digest of the served params (cached; invalidated on
+        ``set_model``). Generation-stamped into the fleet registration so
+        a rolling deploy can gate on "replica serves the NEW model"."""
+        if self._model_digest is None:
+            params = getattr(self._model, "params", None)
+            if params is None:
+                self._model_digest = type(self._model).__name__
+            else:
+                from ..util.durable import params_digest
+                self._model_digest = params_digest(params)[:16]
+        return self._model_digest
+
+    @property
+    def model_generation(self) -> int:
+        """Monotonic count of completed model swaps on this replica."""
+        return self._model_generation
 
     def _health(self) -> dict:
+        reasons = self.readiness_reasons()
         h = {"ok": not self._draining
                    and self.breaker.state != "open",
+             "live": self.live,
+             "ready": not reasons,
+             "ready_reasons": reasons,
              "model": type(self._model).__name__,
+             "model_digest": self.model_digest,
+             "model_generation": self._model_generation,
              "served": self.served,
              "shed": self.shed,
              "queue_depth": self._queue.qsize(),
@@ -475,10 +590,17 @@ class InferenceServer:
         if req.finish_reason is None:      # scheduler wedged — honest 504
             return {"error": "generation timeout"}, 504, None, tp
         if req.finish_reason == "error":
-            return ({"error": req.error or "decode failed"}, 500, None,
-                    tp)
+            # the request died with the ENGINE (pools rebuilt), not on
+            # its own terms: return the preserved partial output and a
+            # retryable verdict — the contract a fleet router's
+            # idempotent replay depends on
+            return ({"error": req.error or "decode failed",
+                     "retryable": True,
+                     "tokens": [int(t) for t in req.tokens],
+                     "n_generated": len(req.tokens)}, 500, None, tp)
         if req.finish_reason == "shutdown":
-            return {"error": "server shutting down"}, 503, None, tp
+            return ({"error": "server shutting down",
+                     "retryable": True}, 503, None, tp)
         if req.finish_reason == "deadline" and not req.tokens:
             return {"error": "request deadline exceeded"}, 504, None, tp
         body = {"tokens": [int(t) for t in req.tokens],
@@ -670,17 +792,28 @@ class InferenceServer:
         mid-decode swap would mis-read every live K/V page (the cache
         holds the old model's activations). Drain first."""
         if self.decode is not None:
-            with self.decode.fence() as in_flight:
-                if in_flight:
-                    raise ModelSwapRefused(
-                        f"refusing model swap: {in_flight} generative "
-                        "sequence(s) in flight — drain() first")
-                self.decode.engine.swap_net(model)
-                with self._lock:
-                    self._model = model
+            # readiness gates admission for the whole fence window, so a
+            # router stops sending BEFORE the swap instead of bouncing
+            # off ModelSwapRefused
+            self._swapping = True
+            try:
+                with self.decode.fence() as in_flight:
+                    if in_flight:
+                        raise ModelSwapRefused(
+                            f"refusing model swap: {in_flight} generative "
+                            "sequence(s) in flight — drain() first")
+                    self.decode.engine.swap_net(model)
+                    with self._lock:
+                        self._model = model
+            finally:
+                self._swapping = False
+            self._model_digest = None
+            self._model_generation += 1
             return
         with self._lock:
             self._model = model
+        self._model_digest = None
+        self._model_generation += 1
 
     def swap_model_from(self, path: str) -> None:
         """Load a checkpoint zip (util.serialization) and serve it."""
@@ -692,19 +825,53 @@ class InferenceServer:
         and wait until everything already accepted has been answered —
         including in-flight generative sequences, which keep decoding
         until they finish or hit their own SLO deadline. True if fully
-        drained within ``timeout``."""
+        drained within ``timeout``.
+
+        Outcome is never silent: every drain counts into
+        ``serving_drain_total{result}``, and a timeout additionally
+        records a ``serving_drain_timeout`` flight event NAMING the
+        requests still in flight — half-drained is an operator page with
+        attribution, not a bare False."""
         self._draining = True
         deadline = time.perf_counter() + timeout
         ok = True
         if self.decode is not None:
             ok = self.decode.drain(timeout=timeout)
+        drained = False
         while time.perf_counter() < deadline:
             with self._pending_lock:
                 if self._pending == 0:
-                    return ok
+                    drained = True
+                    break
             time.sleep(0.005)
-        with self._pending_lock:
-            return ok and self._pending == 0
+        if not drained:
+            with self._pending_lock:
+                drained = self._pending == 0
+        ok = ok and drained
+        self._m_drain.inc(result="ok" if ok else "timeout")
+        if not ok:
+            from ..util import flightrecorder as _flight
+            _flight.record("serving_drain_timeout",
+                           pending_predicts=self._pending,
+                           in_flight=self._in_flight_decodes())
+        return ok
+
+    def _in_flight_decodes(self) -> List[dict]:
+        """Identify the generative requests still active — lane, progress
+        and trace id — so a drain timeout names exactly what it left
+        behind (the payload of the ``serving_drain_timeout`` event)."""
+        if self.decode is None:
+            return []
+        out = []
+        for seq in list(self.decode._active.values()):
+            req = seq.req
+            out.append({"lane": seq.lane,
+                        "prompt_len": len(req.prompt),
+                        "generated": len(req.tokens),
+                        "max_new_tokens": req.max_new_tokens,
+                        "trace_id": (req.span.trace_id
+                                     if req.span is not None else None)})
+        return out
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Graceful shutdown: by default drains queued requests first so a

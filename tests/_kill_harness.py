@@ -37,6 +37,17 @@ and rejoins), and SIGKILLs hang-mode ranks once every other rank
 finished — the parent is the cluster scheduler of the chaos story.
 Each child writes ``result_<host>.json`` (final digest, agreed flag,
 rounds, membership-transition counts) into its checkpoint dir.
+
+SERVING MODE (``mode: "serving"``): each child is an InferenceServer
+replica with continuous-batched decode, registered in the shared store
+by a ReplicaAgent (serving/fleet.py) — the parent runs a FleetRouter
+over the same store and drives Poisson load while per-replica kill
+plans SIGTERM or hang a replica at an exact decode-dispatch count
+(the ``"serving.decode_step"`` seam, so the kill lands MID-DECODE with
+partial output in flight). Children serve until the parent publishes
+``ctl/stop``, then drain, deregister, and write ``result_<host>.json``
+(responses by code, shed, drain + heartbeat counters). ``run_fleet``
+reclaims hang-mode replicas exactly like hang-mode trainers.
 """
 
 from __future__ import annotations
@@ -324,6 +335,146 @@ def elastic_batch_fn(seed: int, host_index: int):
     return fn
 
 
+# ----------------------------------------------------------------------
+# serving mode: N decode replicas + router-driven chaos
+# ----------------------------------------------------------------------
+
+SERVE_VOCAB = 32
+SERVE_WINDOW = 32       # page_size 8 × pages_per_seq 4
+
+
+def build_lm_net(seed: int = 7):
+    """Tiny decode-capable transformer shared by every serving child and
+    the parent-side router tests — small enough that three replicas warm
+    their bucket ladders concurrently on one core inside the budget."""
+    from deeplearning4j_tpu.models import transformer_lm
+    from deeplearning4j_tpu.nn.graph_runtime import ComputationGraph
+    conf = transformer_lm(SERVE_VOCAB, n_layers=1, d_model=32, n_heads=2,
+                          d_ff=64, seed=seed, input_ids=True,
+                          max_cache_t=SERVE_WINDOW)
+    return ComputationGraph(conf).init()
+
+
+def serving_fleet_configs(n: int, store_dir: str, base_dir: str, *,
+                          lease_s: float = 1.0,
+                          request_timeout_s: float = 30.0,
+                          run_s: float = 120.0, seed: int = 7,
+                          kill_plans: dict = None) -> list:
+    """One config per replica. ``kill_plans`` maps index ->
+    {"kill_mode": "sigterm"|"hang", "kill_at_dispatch": N} — N counts
+    DECODE-phase dispatches on that replica (prefills excluded), so the
+    kill is guaranteed to land mid-decode with tokens already emitted."""
+    out = []
+    for i in range(n):
+        host = f"r{i}"
+        cfg = {"mode": "serving", "host": host, "store_dir": store_dir,
+               "checkpoint_dir": os.path.join(base_dir, host),
+               "lease_s": lease_s,
+               "request_timeout_s": request_timeout_s,
+               "run_s": run_s, "seed": seed}
+        cfg.update((kill_plans or {}).get(i, {}))
+        out.append(cfg)
+    return out
+
+
+def _serving_child_main(config: dict) -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import signal
+    import time
+
+    from deeplearning4j_tpu.parallel.elastic import FileCoordinationStore
+    from deeplearning4j_tpu.serving import InferenceServer, ReplicaAgent
+    from deeplearning4j_tpu.util import faults
+    from deeplearning4j_tpu.util import metrics as _metrics
+    from deeplearning4j_tpu.util import tracing as _tracing
+
+    directory = config["checkpoint_dir"]
+    os.makedirs(directory, exist_ok=True)
+    os.environ["DL4JTPU_FLIGHT_DIR"] = directory
+    if config.get("traceparent"):
+        os.environ["DL4JTPU_TRACEPARENT"] = config["traceparent"]
+
+    replica = config["host"]
+    store = FileCoordinationStore(config["store_dir"])
+    registry = _metrics.REGISTRY
+    tracer = _tracing.Tracer(host=replica, registry=registry)
+    server = InferenceServer(
+        build_lm_net(config.get("seed", 7)),
+        tracer=tracer, registry=registry,
+        decode={"max_batch": 2, "page_size": 8, "pages_per_seq": 4,
+                "prefill_chunk": 8,
+                "request_timeout_s": config.get("request_timeout_s",
+                                                30.0)},
+        warmup_background=True)
+    # registration happens BEFORE the warmup finishes: the replica is
+    # visible (ready=false) while the bucket ladder compiles, and the
+    # router's readiness gate keeps traffic away until it flips
+    agent = ReplicaAgent(server, store, replica=replica,
+                         lease_s=config.get("lease_s", 1.0),
+                         registry=registry).start()
+
+    plan = faults.FaultPlan()
+    kill_mode = config.get("kill_mode")
+    kill_at = config.get("kill_at_dispatch")
+    if kill_mode:
+        state = {"n": 0}
+
+        def kill(payload):
+            if payload.get("phase") == "prefill":
+                return
+            state["n"] += 1
+            if state["n"] == kill_at:
+                if kill_mode == "hang":
+                    # wedge INSIDE the dispatch, dispatch lock held: the
+                    # agent's step-boundary probe now fails, heartbeats
+                    # stop, and the lease lapses — the hang is visible
+                    # to the fleet precisely because liveness is
+                    # attested, not assumed
+                    time.sleep(600)
+                    return
+                os.kill(os.getpid(), signal.SIGTERM)
+        plan.always("serving.decode_step", exc=kill)
+
+    deadline = time.monotonic() + config.get("run_s", 120.0)
+    with plan.active():
+        while time.monotonic() < deadline:
+            if store.get("ctl/stop") is not None:
+                break
+            time.sleep(0.1)
+        agent.stop(deregister=True)
+        server.stop(drain=True, timeout=10.0)
+
+    try:
+        tracer.export_jsonl(os.path.join(directory,
+                                         f"trace_{replica}.jsonl"))
+    except Exception:
+        pass
+    responses = {}
+    resp = registry.get("serving_responses_total")
+    if resp is not None:
+        for s in resp.snapshot()["series"]:
+            responses[s["labels"]["code"]] = s["value"]
+
+    def _ctr(name, **labels):
+        m = registry.get(name)
+        return m.value(**labels) if m is not None else 0.0
+
+    result = {
+        "host": replica,
+        "served": server.served,
+        "shed": server.shed,
+        "responses": responses,
+        "heartbeats_published": _ctr("fleet_heartbeats_total",
+                                     result="published"),
+        "drain_ok": _ctr("serving_drain_total", result="ok"),
+        "drain_timeout": _ctr("serving_drain_total", result="timeout"),
+    }
+    with open(os.path.join(directory, f"result_{replica}.json"), "w") as f:
+        json.dump(result, f)
+
+
 def _install_kill_plan(plan, config) -> None:
     """Per-rank kill plan on the shared "training.step" seam: the seam
     fires BEFORE dispatching the (iteration+1)-th local step."""
@@ -522,5 +673,7 @@ if __name__ == "__main__":
     _config = json.loads(sys.argv[1])
     if _config.get("mode") == "elastic":
         _elastic_child_main(_config)
+    elif _config.get("mode") == "serving":
+        _serving_child_main(_config)
     else:
         _child_main(_config)

@@ -11,6 +11,15 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# NOTE on the persistent XLA compile cache: do NOT enable it globally
+# here. Measured on this harness (jax 0.4.37, CPU), executables loaded
+# back from the disk cache are not bit-identical to freshly compiled
+# ones — warm-cache runs break the elastic trainers' digest-chain
+# tests (test_elastic.py TestInProcessFleet), whose bit-exact replay is
+# a core guarantee. The examples smoke job enables it for its own
+# subprocesses only (tests/test_examples.py), where nothing asserts
+# bit-exactness and compile time dominates.
+
 # The axon TPU plugin preloads jax at interpreter startup (sitecustomize), so
 # env vars like JAX_PLATFORMS are read too late — use the config API, which
 # works as long as no backend has been initialized yet.

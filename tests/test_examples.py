@@ -1,8 +1,12 @@
 """Every example in examples/ must run end-to-end in --smoke mode.
 
 Examples are user-facing documentation; a broken example is a broken
-contract. Each runs in a subprocess on the forced-CPU 8-device mesh (same
-environment as the rest of the suite)."""
+contract. Examples sharing a mesh size run sequentially in ONE
+subprocess (forced-CPU mesh, same environment as the rest of the suite):
+the interpreter + jax import tax is paid once per mesh size instead of
+once per script, which keeps this job inside the tier-1 budget. The
+driver prints an ``OK <script>`` marker per example so a group failure
+still attributes to the script that broke."""
 
 import os
 import subprocess
@@ -24,23 +28,55 @@ _EXAMPLES = sorted(
 # examples run their smoke tests on reduced meshes (2 for the per-step-psum
 # dp example, 4 for the multi-mode parallel transformer — the smallest
 # count that still exercises its composed 2-D branch); everything else
-# keeps the suite-standard 8.
+# keeps the suite-standard 8. Device count is fixed per process, so the
+# groups below are exactly the mesh sizes.
 _DEVICE_COUNT = {"data_parallel_training.py": 2,
                  "parallel_transformer.py": 4}
 
+_GROUPS: dict = {}
+for _f in _EXAMPLES:
+    _GROUPS.setdefault(_DEVICE_COUNT.get(_f, 8), []).append(_f)
 
-@pytest.mark.parametrize("script", _EXAMPLES)
-def test_example_smoke(script):
-    n_dev = _DEVICE_COUNT.get(script, 8)
+_DRIVER = r"""
+import runpy, sys, traceback
+for s in sys.argv[1:]:
+    sys.argv = [s, "--smoke"]
+    try:
+        runpy.run_path(s, run_name="__main__")
+    except SystemExit as e:
+        if e.code not in (None, 0):
+            print(f"FAILED {s} (SystemExit {e.code})", flush=True)
+            sys.exit(1)
+    except BaseException:
+        print(f"FAILED {s}:", flush=True)
+        traceback.print_exc()
+        sys.exit(1)
+    print(f"OK {s}", flush=True)
+"""
+
+
+@pytest.mark.parametrize("n_dev", sorted(_GROUPS),
+                         ids=lambda n: f"mesh{n}")
+def test_example_smoke(n_dev):
+    scripts = _GROUPS[n_dev]
+    # Persistent compile cache, scoped to THIS job's subprocesses: the
+    # smoke groups are compile-dominated (the mesh8 group most of all)
+    # and none of the examples assert bit-exactness, so warm-cache
+    # executables are fine HERE. Do not widen this to the whole suite:
+    # cache-loaded executables measurably diverge (last-ulp) from
+    # freshly compiled ones on this harness, which breaks the elastic
+    # digest-chain tests (see tests/conftest.py).
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                XLA_FLAGS=f"--xla_force_host_platform_device_count={n_dev}",
+               JAX_COMPILATION_CACHE_DIR="/tmp/jax_examples_cache",
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.3",
                PYTHONPATH=_REPO)
     first = None
     for attempt in (1, 2):
         proc = subprocess.run(
-            [sys.executable, os.path.join(_REPO, "examples", script),
-             "--smoke"],
+            [sys.executable, "-c", _DRIVER,
+             *(os.path.join(_REPO, "examples", s) for s in scripts)],
             capture_output=True, text=True, env=env, timeout=900,
             cwd=_REPO)
         if proc.returncode == 0:
@@ -53,13 +89,18 @@ def test_example_smoke(script):
     if proc.returncode == 0 and first is not None:
         # a pass that NEEDED its retry must be loud, not silent: a real
         # intermittent bug hiding as "tunnel flake" shows up here as this
-        # warning recurring for the same script across runs — treat that
+        # warning recurring for the same group across runs — treat that
         # as a failure and investigate (r4 verdict weak #6)
         import warnings
         warnings.warn(
-            f"{script} passed only on retry — first attempt:\n{first}",
-            stacklevel=2)
+            f"mesh{n_dev} group passed only on retry — first attempt:\n"
+            f"{first}", stacklevel=2)
     assert proc.returncode == 0, (
-        f"{script} failed twice.\nFirst attempt: {first}\n"
+        f"mesh{n_dev} group ({', '.join(scripts)}) failed twice.\n"
+        f"First attempt: {first}\n"
         f"Second attempt (rc={proc.returncode}):\n"
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    # every script in the group must have reported, in order
+    for s in scripts:
+        assert f"OK {os.path.join(_REPO, 'examples', s)}" in proc.stdout, (
+            f"{s} did not report OK\nstdout:\n{proc.stdout}")

@@ -1,0 +1,635 @@
+"""Serving fleet tier (ISSUE 20): replica registration over the lease
+substrate, decode-aware routing, idempotent failover replay, readiness
+split, drain telemetry, and the engine-level replica-loss contract.
+
+The in-process tests here use real InferenceServer replicas behind a
+real FleetRouter over an InMemoryCoordinationStore — the full HTTP
+path, no mocks. The N-process SIGTERM/hang chaos proof lives in
+test_fleet_chaos.py.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import _kill_harness as harness
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.elastic import (InMemoryCoordinationStore,
+                                                 LeaseMembership)
+from deeplearning4j_tpu.serving import (DecodeScheduler, FleetRouter,
+                                        InferenceServer, PagedDecodeEngine,
+                                        ReplicaAgent)
+from deeplearning4j_tpu.util import faults
+from deeplearning4j_tpu.util import flightrecorder as _flight
+from deeplearning4j_tpu.util.metrics import MetricsRegistry
+from deeplearning4j_tpu.util.resilience import ManualClock
+from deeplearning4j_tpu.util.tracing import Tracer
+
+DECODE_CFG = {"max_batch": 2, "page_size": 8, "pages_per_seq": 4,
+              "prefill_chunk": 8}
+
+
+def _dense_net(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater("sgd")
+            .learning_rate(0.1).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(port, path, payload, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(port, path, timeout=10):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def wait_until(fn, timeout=30.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(every)
+    assert fn(), f"timed out waiting for {msg}"
+
+
+def _dead_addr():
+    """An address nothing listens on (bind, grab the port, close)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+# ----------------------------------------------------------------------
+# the generalized lease substrate (parallel/elastic.LeaseMembership)
+# ----------------------------------------------------------------------
+
+class TestLeaseMembershipDynamic:
+    def test_discovery_transitions_and_docs(self):
+        """Dynamic mode: members self-register by publishing; the
+        observer needs no fleet spec; join/evict/rejoin/done transitions
+        are recorded with the serving flight kind."""
+        store = InMemoryCoordinationStore()
+        reg = MetricsRegistry()
+        obs = LeaseMembership(store, observer="router", lease_s=0.2,
+                              registry=reg, flight_kind="fleet_membership")
+        pub = LeaseMembership(store, observer="pub", lease_s=0.2)
+        assert obs.members() == ()
+        pub.publish("a", {"status": "live", "ready": True,
+                          "capacity": {"free_pages": 9}})
+        pub.publish("b", {"status": "live", "ready": False})
+        v = obs.view()
+        assert set(v) == {"a", "b"}
+        assert v["a"]["alive"] and not v["a"]["done"]
+        assert v["a"]["doc"]["capacity"]["free_pages"] == 9
+        tc = reg.get("membership_transitions_total")
+        assert tc.value(event="join", host="a") == 1
+        assert tc.value(event="join", host="b") == 1
+        # lease lapse -> evict; a fresh publish -> rejoin
+        time.sleep(0.35)
+        v = obs.view()
+        assert not v["a"]["alive"]
+        assert tc.value(event="evict", host="a") == 1
+        pub.publish("a", {"status": "live"})
+        v = obs.view()
+        assert v["a"]["alive"]
+        assert tc.value(event="rejoin", host="a") == 1
+        # clean leave: done docs stay "alive" (no evict page)
+        pub.publish("b", {"status": "done"})
+        v = obs.view()
+        assert v["b"]["done"] and v["b"]["alive"]
+
+    def test_incarnation_counts_restarts(self):
+        store = InMemoryCoordinationStore()
+        lm = LeaseMembership(store, observer="x", lease_s=1.0)
+        assert lm.next_incarnation("r0") == 1
+        lm.publish("r0", {"incarnation": 1})
+        assert lm.next_incarnation("r0") == 2
+
+
+# ----------------------------------------------------------------------
+# satellite 1: liveness vs readiness split
+# ----------------------------------------------------------------------
+
+class TestReadinessSplit:
+    def test_livez_readyz_and_health_fields(self):
+        srv = InferenceServer(_dense_net(), port=0)
+        try:
+            code, h = _get(srv.port, "/healthz")
+            assert code == 200
+            assert h["live"] is True and h["ready"] is True
+            assert h["ready_reasons"] == []
+            assert isinstance(h["model_digest"], str) and h["model_digest"]
+            assert h["model_generation"] == 0
+            assert _get(srv.port, "/livez") == (200, {"live": True})
+            assert _get(srv.port, "/readyz") == (
+                200, {"ready": True, "reasons": []})
+            # an open breaker gates READINESS, not liveness: the router
+            # routes around it; nothing should restart the process
+            for _ in range(3):
+                srv.breaker.record_failure()
+            assert srv.breaker.state == "open"
+            code, body = _get(srv.port, "/readyz")
+            assert code == 503 and body["reasons"] == ["breaker_open"]
+            assert _get(srv.port, "/livez")[0] == 200
+            assert _get(srv.port, "/healthz")[1]["live"] is True
+        finally:
+            srv.stop(drain=False)
+
+    def test_draining_is_not_ready_but_live(self):
+        srv = InferenceServer(_dense_net(), port=0)
+        try:
+            assert srv.drain(timeout=5.0)
+            code, body = _get(srv.port, "/readyz")
+            assert code == 503 and "draining" in body["reasons"]
+            assert _get(srv.port, "/livez")[0] == 200
+            # back-compat: the pre-split health bit still flips
+            assert _get(srv.port, "/healthz")[1]["ok"] is False
+        finally:
+            srv.stop(drain=False)
+
+    def test_background_warmup_reports_warming_then_ready(self):
+        """A fleet replica registers (ready=false, reason=warming) while
+        the decode bucket ladder compiles, instead of being invisible
+        for the whole warmup."""
+        store = InMemoryCoordinationStore()
+        srv = InferenceServer(harness.build_lm_net(5), port=0,
+                              decode=dict(DECODE_CFG),
+                              warmup_background=True)
+        agent = None
+        try:
+            # constructor returns while the ladder is still compiling
+            assert "warming" in srv.readiness_reasons()
+            agent = ReplicaAgent(srv, store, replica="w0", lease_s=2.0)
+            assert agent.beat()  # warming replicas may publish unprobed
+            doc = store.get_json("hb/w0.json")
+            assert doc["ready"] is False
+            assert "warming" in doc["ready_reasons"]
+            assert doc["capacity"]["free_pages"] > 0
+            wait_until(lambda: srv.ready, timeout=120, msg="warmup")
+            assert agent.beat()
+            doc = store.get_json("hb/w0.json")
+            assert doc["ready"] is True and doc["ready_reasons"] == []
+            # and it actually serves
+            code, body, _ = _post(srv.port, "/generate",
+                                  {"prompt_ids": [1, 2, 3],
+                                   "max_new_tokens": 3})
+            assert code == 200 and len(body["tokens"]) == 3
+            agent.stop(deregister=True)
+            assert store.get_json("hb/w0.json")["status"] == "done"
+        finally:
+            if agent is not None:
+                agent.stop(deregister=False)
+            srv.stop(drain=False)
+
+
+# ----------------------------------------------------------------------
+# satellite 2: drain telemetry (serving_drain_total + flight naming)
+# ----------------------------------------------------------------------
+
+class _BlockingModel:
+    def __init__(self, width=3):
+        self.width = width
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def output(self, x):
+        self.entered.set()
+        assert self.release.wait(timeout=30)
+        return np.zeros((x.shape[0], self.width), np.float32)
+
+
+@pytest.mark.chaos
+class TestDrainTelemetry:
+    def test_drain_timeout_counts_and_names_pending_predicts(self):
+        model = _BlockingModel()
+        srv = InferenceServer(model, port=0, max_batch=1)
+        t = threading.Thread(target=_post, args=(
+            srv.port, "/predict", {"inputs": [[0.0, 0.0, 0.0]]}))
+        try:
+            n_before = len(_flight.events("serving_drain_timeout"))
+            t.start()
+            assert model.entered.wait(timeout=10)
+            assert srv.drain(timeout=0.2) is False
+            drains = srv.registry.get("serving_drain_total")
+            assert drains.value(result="timeout") == 1
+            evs = _flight.events("serving_drain_timeout")
+            assert len(evs) == n_before + 1
+            assert evs[-1]["pending_predicts"] >= 1
+            # release: the held request completes, a re-drain succeeds
+            model.release.set()
+            t.join(timeout=30)
+            assert srv.drain(timeout=10.0) is True
+            assert drains.value(result="ok") == 1
+        finally:
+            model.release.set()
+            srv.stop(drain=False)
+
+    def test_drain_timeout_names_in_flight_decodes(self):
+        """The flight event identifies WHICH generative requests the
+        timed-out drain left behind — lane, progress, trace id — not
+        just a bare False."""
+        reg = MetricsRegistry()
+        tracer = Tracer()
+        net = harness.build_lm_net(5)
+        eng = PagedDecodeEngine(net, registry=reg, **DECODE_CFG)
+        sched = DecodeScheduler(eng, clock=ManualClock(), registry=reg,
+                                tracer=tracer, start_thread=False)
+        srv = InferenceServer(net, port=0, decode=sched)
+        try:
+            req = sched.submit([1, 2, 3], max_new_tokens=8)
+            sched.step_once()  # admit + prefill: now in flight
+            assert not req.done
+            # zero budget: the drain cannot step the threadless
+            # scheduler at all, so the timeout path is deterministic
+            assert srv.drain(timeout=0.0) is False
+            ev = _flight.events("serving_drain_timeout")[-1]
+            assert len(ev["in_flight"]) == 1
+            entry = ev["in_flight"][0]
+            assert entry["prompt_len"] == 3
+            assert entry["max_new_tokens"] == 8
+            assert entry["trace_id"] == req.span.trace_id
+            # threadless scheduler: finish the sequence inline, then the
+            # drain completes and counts result="ok"
+            for _ in range(200):
+                if req.done:
+                    break
+                sched.step_once()
+            assert req.done
+            assert srv.drain(timeout=10.0) is True
+            assert srv.registry.get("serving_drain_total").value(
+                result="ok") == 1
+        finally:
+            srv.stop(drain=False)
+
+
+# ----------------------------------------------------------------------
+# satellite 3: replica loss at the engine level — error-retired requests
+# keep their partial output and surface a retryable verdict
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestReplicaLossEngine:
+    def test_error_retire_preserves_partial_output_and_retryability(self):
+        reg = MetricsRegistry()
+        net = harness.build_lm_net(5)
+        eng = PagedDecodeEngine(net, registry=reg, **DECODE_CFG)
+        sched = DecodeScheduler(eng, clock=ManualClock(), registry=reg,
+                                start_thread=False)
+        r1 = sched.submit([1, 2, 3], max_new_tokens=12)
+        r2 = sched.submit([4, 5], max_new_tokens=12)
+        for _ in range(200):
+            if len(r1.tokens) >= 2 and len(r2.tokens) >= 2:
+                break
+            sched.step_once()
+        assert len(r1.tokens) >= 2 and len(r2.tokens) >= 2
+        partial = (list(r1.tokens), list(r2.tokens))
+        plan = faults.FaultPlan().fail("serving.decode_step", times=1)
+        with plan.active():
+            sched.step_once()  # the dispatch dies mid-decode
+        for r, before in zip((r1, r2), partial):
+            assert r.done and r.finish_reason == "error"
+            assert r.retryable is True
+            assert r.tokens[:len(before)] == before  # partials preserved
+            assert "InjectedFault" in r.error
+        assert reg.get("decode_retired_total").value(reason="error") == 2
+        # the pool rebuild leaves the engine serving: a fresh request
+        # runs to completion on recycled lanes/pages
+        r3 = sched.submit([6, 7, 8], max_new_tokens=4)
+        for _ in range(200):
+            if r3.done:
+                break
+            sched.step_once()
+        assert r3.finish_reason == "max_tokens" and len(r3.tokens) == 4
+        assert r3.retryable is False
+
+    def test_http_surface_of_error_retire(self):
+        """Through the server, an error-retired generate answers 500
+        with retryable=true and the partial tokens — what the router's
+        replay classification keys on."""
+        reg = MetricsRegistry()
+        net = harness.build_lm_net(5)
+        eng = PagedDecodeEngine(net, registry=reg, **DECODE_CFG)
+        eng.warmup()
+        sched = DecodeScheduler(eng, registry=reg)  # threaded
+        srv = InferenceServer(net, port=0, decode=sched, registry=reg)
+        try:
+            plan = faults.FaultPlan().fail(
+                "serving.decode_step", times=1,
+                after=2)  # let prefill + a couple of decode steps land
+            with plan.active():
+                code, body, _ = _post(srv.port, "/generate",
+                                      {"prompt_ids": [1, 2, 3],
+                                       "max_new_tokens": 8})
+            assert code == 500
+            assert body["retryable"] is True
+            assert "tokens" in body and "n_generated" in body
+        finally:
+            srv.stop(drain=False)
+
+
+# ----------------------------------------------------------------------
+# router unit: routing policy + shed plane
+# ----------------------------------------------------------------------
+
+class TestRoutingPolicy:
+    def _doc(self, free_pages, queue=0, active=0, ready=True,
+             status="live", addr="127.0.0.1:1", pages_per_seq=4):
+        return {"status": status, "ready": ready, "addr": addr,
+                "capacity": {"free_pages": free_pages,
+                             "queue_depth": queue, "active": active,
+                             "pages_per_seq": pages_per_seq}}
+
+    def test_pick_is_decode_aware_never_round_robin(self):
+        store = InMemoryCoordinationStore()
+        router = FleetRouter(store, lease_s=30.0, view_refresh_s=0.0)
+        pub = LeaseMembership(store, observer="t", lease_s=30.0)
+        try:
+            pub.publish("a", self._doc(4))
+            pub.publish("b", self._doc(12))
+            pub.publish("c", self._doc(12, queue=3))
+            # most free pages wins; equal pages -> shortest queue
+            for _ in range(5):  # stable, not rotating
+                assert router._pick()[0] == "b"
+            # router-side inflight discounts a stale heartbeat: two
+            # uncounted sends shrink b by 2 x pages_per_seq, so c's 12
+            # free pages now win
+            router._track("b", +1)
+            router._track("b", +1)
+            assert router._pick()[0] == "c"
+            # pages tied (a=4, b=12-2x4) -> inflight counts into b's
+            # queue and the SHORTER queue breaks the tie
+            router._cordoned.add("c")
+            assert router._pick()[0] == "a"
+            router._cordoned.discard("c")
+            router._track("b", -1)
+            router._track("b", -1)
+            # not-ready and cordoned replicas are unroutable
+            pub.publish("b", self._doc(12, ready=False))
+            assert router._pick()[0] == "c"
+            router._cordoned.add("c")
+            assert router._pick()[0] == "a"
+            router._cordoned.discard("c")
+            assert router._pick(exclude=("a", "c"))[0] is None
+        finally:
+            router.stop()
+
+    def test_no_replica_sheds_on_serving_plane_with_retry_after(self):
+        store = InMemoryCoordinationStore()
+        router = FleetRouter(store, lease_s=1.0, shed_grace_s=0.0)
+        try:
+            code, body, headers = _post(router.port, "/generate",
+                                        {"prompt_ids": [1, 2]})
+            assert code == 503
+            assert body["retryable"] is True
+            assert "Retry-After" in headers
+            assert router.registry.get("serving_shed_total").value(
+                reason="no_replica") == 1
+            assert router.registry.get("fleet_requests_total").value(
+                outcome="shed") == 1
+        finally:
+            router.stop()
+
+    def test_shed_grace_bridges_late_registration(self):
+        """An empty routable set is polled for up to shed_grace_s
+        before the router sheds: a replica whose heartbeat lands a beat
+        late still receives the request instead of the caller eating a
+        503. (The late 'replica' here is a dead address, so the request
+        ends 503 'exhausted' — but with an attempt on the audit trail,
+        proving routing picked it up mid-grace rather than shedding on
+        the empty view.)"""
+        store = InMemoryCoordinationStore()
+        router = FleetRouter(store, lease_s=1.0, shed_grace_s=1.5,
+                             retry_budget=0)
+        pub = LeaseMembership(store, observer="late", lease_s=1.0)
+
+        def publish_late():
+            time.sleep(0.3)
+            pub.publish("g1", self._doc(8, addr=_dead_addr()))
+
+        threading.Thread(target=publish_late).start()
+        try:
+            code, body, _ = _post(router.port, "/generate",
+                                  {"prompt_ids": [1],
+                                   "idempotency_key": "late-1"})
+            assert code == 503
+            trail = router._audit["late-1"]["attempts"]
+            assert [a["replica"] for a in trail] == ["g1"]
+            assert router.registry.get("fleet_requests_total").value(
+                outcome="shed") == 0
+        finally:
+            router.stop()
+
+
+# ----------------------------------------------------------------------
+# router integration: 2 real replicas, full HTTP path
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet2():
+    """Two warmed replicas registered behind a router. Module-scoped:
+    the warmups dominate; every test leaves the fleet serving."""
+    store = InMemoryCoordinationStore()
+    servers, agents = [], []
+    for i in range(2):
+        srv = InferenceServer(harness.build_lm_net(5), port=0,
+                              decode=dict(DECODE_CFG),
+                              tracer=Tracer(host=f"r{i}"))
+        agents.append(ReplicaAgent(srv, store, replica=f"r{i}",
+                                   lease_s=1.5).start())
+        servers.append(srv)
+    # shed_grace covers a worst-case single XLA compile on this 1-core
+    # harness: a replica's in-process heartbeat thread can be starved
+    # past the 1.5 s lease while its sibling's set_model re-warmup holds
+    # the GIL, and the router must bridge that gap, not shed into it
+    router = FleetRouter(store, lease_s=1.5, retry_budget=2,
+                         request_timeout_s=30.0, attempt_timeout_s=10.0,
+                         shed_grace_s=8.0, tracer=Tracer(host="router"))
+    wait_until(lambda: router._health()["ready"] == 2, timeout=30,
+               msg="2 ready replicas")
+    yield {"store": store, "servers": servers, "agents": agents,
+           "router": router}
+    router.stop()
+    for a in agents:
+        a.stop(deregister=False)
+    for s in servers:
+        s.stop(drain=False)
+
+
+class TestFleetIntegration:
+    def test_routes_with_attribution_and_traceparent_propagation(
+            self, fleet2):
+        router = fleet2["router"]
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        code, body, _ = _post(router.port, "/generate",
+                              {"prompt_ids": [1, 2, 3, 4],
+                               "max_new_tokens": 4},
+                              headers={"traceparent": tp})
+        assert code == 200
+        assert len(body["tokens"]) == 4
+        assert body["replica"] in ("r0", "r1")
+        assert body["attempts"] == 1
+        assert body["idempotency_key"]
+        # the caller's traceparent parents the fleet.request root, and
+        # the replica_call child carries the hop into the replica
+        roots = [s for s in router.tracer.find("fleet.request")
+                 if s.trace_id == "ab" * 16]
+        assert len(roots) == 1
+        calls = [s for s in router.tracer.find("fleet.replica_call")
+                 if s.trace_id == "ab" * 16]
+        assert calls and calls[0].attributes["replica"] == body["replica"]
+        # the replica's own decode.request span joined the same trace
+        srv = fleet2["servers"][int(body["replica"][1])]
+        assert any(s.trace_id == "ab" * 16
+                   for s in srv.tracer.find("decode.request"))
+        # /debug/timeline renders the routed request
+        code, tl = _get(router.port, "/debug/timeline?trace_id=" + "ab" * 16)
+        assert code == 200 and len(tl["requests"]) == 1
+
+    def test_idempotency_key_dedupes_concurrent_submissions(self, fleet2):
+        router = fleet2["router"]
+        payload = {"prompt_ids": [5, 6, 7], "max_new_tokens": 5,
+                   "idempotency_key": "dedupe-1"}
+        results = [None, None]
+
+        def call(i):
+            results[i] = _post(router.port, "/generate", dict(payload))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        (c0, b0, h0), (c1, b1, h1) = results
+        assert c0 == 200 and c1 == 200
+        assert b0["tokens"] == b1["tokens"]  # one serve, one replay
+        replays = [h for h in (h0, h1)
+                   if h.get("x-idempotent-replay") == "true"]
+        assert len(replays) == 1
+        assert router.registry.get("fleet_requests_total").value(
+            outcome="deduplicated") == 1
+        code, audit = _get(router.port, "/debug/audit")
+        trail = audit["audit"]["dedupe-1"]
+        assert len(trail["attempts"]) == 1 and trail["code"] == 200
+
+    def test_failover_replays_on_survivor(self, fleet2):
+        """A picked replica whose connection dies mid-call: the router
+        replays on a survivor inside the same request — 200 to the
+        caller, failover counted, hop named in timeline + black box."""
+        router = fleet2["router"]
+        store = fleet2["store"]
+        ghost = LeaseMembership(store, observer="ghost", lease_s=1.5)
+        # a fresh lease advertising the most capacity — routing will
+        # pick it first — at an address nothing listens on
+        ghost.publish("zz-ghost", {
+            "status": "live", "ready": True, "addr": _dead_addr(),
+            "incarnation": 1,
+            "capacity": {"free_pages": 10 ** 6, "queue_depth": 0,
+                         "active": 0, "pages_per_seq": 4}})
+        router.view(force=True)
+        n_flight = len(_flight.events("fleet_failover"))
+        code, body, _ = _post(router.port, "/generate",
+                              {"prompt_ids": [2, 3], "max_new_tokens": 3,
+                               "idempotency_key": "failover-1"})
+        assert code == 200
+        assert body["attempts"] == 2
+        assert body["replica"] in ("r0", "r1")
+        assert router.registry.get("fleet_failovers_total").value(
+            reason="transport") >= 1
+        # audit: both attempts on record, exactly one final answer
+        code, audit = _get(router.port, "/debug/audit")
+        trail = audit["audit"]["failover-1"]["attempts"]
+        assert [a["replica"] for a in trail] == ["zz-ghost",
+                                                 body["replica"]]
+        assert trail[0]["code"] is None  # transport failure
+        # the failover hop is an explicit span + flight event
+        spans = router.tracer.find("fleet.failover")
+        assert any(s.attributes["from_replica"] == "zz-ghost"
+                   and s.attributes["to_replica"] == body["replica"]
+                   for s in spans)
+        evs = _flight.events("fleet_failover")
+        assert len(evs) == n_flight + 1
+        assert evs[-1]["from_replica"] == "zz-ghost"
+        # the ghost's lease lapses into an evict transition like any
+        # other dead replica
+        time.sleep(1.6)
+        assert not router.view(force=True)["zz-ghost"]["alive"]
+        assert router.registry.get("membership_transitions_total").value(
+            event="evict", host="zz-ghost") == 1
+
+    def test_rolling_set_model_zero_shed_under_load(self, fleet2, tmp_path):
+        """Fleet-wide set_model behind per-replica cordon/drain/fence:
+        every request during the roll answers 200, generations bump on
+        both replicas, digests converge on the new model, and the
+        router's shed counter does not move."""
+        from deeplearning4j_tpu.util.serialization import save_model
+        router = fleet2["router"]
+        path = str(tmp_path / "next.zip")
+        save_model(harness.build_lm_net(11), path)
+        digest_before = _get(fleet2["servers"][0].port,
+                             "/healthz")[1]["model_digest"]
+        shed = router.registry.get("serving_shed_total")
+        shed_before = shed.value(reason="no_replica")
+        stop = threading.Event()
+        codes, bad = [], []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                c, b, _ = _post(router.port, "/generate",
+                                {"prompt_ids": [1, 2], "max_new_tokens": 2,
+                                 "idempotency_key": f"roll-{i}"},
+                                timeout=30)
+                codes.append(c)
+                if c != 200:
+                    bad.append((i, c, b))
+                time.sleep(0.05)
+
+        loader = threading.Thread(target=load)
+        loader.start()
+        try:
+            results = router.rolling_set_model(path, drain_timeout_s=30,
+                                               ready_timeout_s=120)
+        finally:
+            stop.set()
+            loader.join(timeout=60)
+        assert [r["replica"] for r in results] == ["r0", "r1"]
+        assert all(r["ok"] for r in results)
+        digests = set()
+        for srv in fleet2["servers"]:
+            h = _get(srv.port, "/healthz")[1]
+            assert h["model_generation"] == 1
+            digests.add(h["model_digest"])
+        assert len(digests) == 1 and digest_before not in digests
+        assert codes and all(c == 200 for c in codes), bad
+        assert shed.value(reason="no_replica") == shed_before
+        ev = _flight.events("fleet_rolling_deploy")[-1]
+        assert ev["replica"] == "r1" and ev["generation"] == 1

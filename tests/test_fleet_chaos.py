@@ -1,0 +1,180 @@
+"""N-process serving-fleet kill test (ISSUE 20 headline): SIGTERM one
+replica and wedge another mid-decode under load, and prove the router
+replays every accepted-but-unfinished request on the survivor within
+its SLO — zero dropped, zero double-served — with the failover hop
+named in the timeline and the membership transitions on record.
+
+Three real replica PROCESSES (tests/_kill_harness.py serving mode) join
+a FileCoordinationStore; the router runs in the parent and drives real
+HTTP traffic. r0 is SIGTERMed on its 6th decode-phase dispatch, r1
+wedges (sleep inside the dispatch, lock held) on its 10th — because the
+heartbeat is attested through the decode step boundary, the wedge stops
+the lease cold. r2 survives and absorbs the replays.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import _kill_harness as harness
+from deeplearning4j_tpu.parallel.elastic import FileCoordinationStore
+from deeplearning4j_tpu.serving import FleetRouter
+from deeplearning4j_tpu.util.tracing import Tracer
+
+pytestmark = [pytest.mark.chaos]
+
+N_REQUESTS = 24
+MAX_NEW = 6
+SLO_S = 25.0
+
+
+def wait_until(fn, timeout, every=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(every)
+    assert fn(), f"timed out waiting for {msg}"
+
+
+class TestServingFleetKill:
+    def test_failover_replays_on_survivor_no_drop_no_double_serve(
+            self, tmp_path):
+        store_dir = str(tmp_path / "store")
+        base_dir = str(tmp_path / "replicas")
+        configs = harness.serving_fleet_configs(
+            3, store_dir, base_dir, lease_s=1.0, run_s=150.0,
+            kill_plans={0: {"kill_mode": "sigterm", "kill_at_dispatch": 6},
+                        1: {"kill_mode": "hang", "kill_at_dispatch": 10}})
+        store = FileCoordinationStore(store_dir)
+        router = FleetRouter(store, lease_s=1.0, retry_budget=3,
+                             request_timeout_s=SLO_S,
+                             attempt_timeout_s=3.0, shed_grace_s=4.0,
+                             tracer=Tracer(host="router"))
+
+        fleet_out = {}
+
+        def run():
+            fleet_out.update(harness.run_fleet(configs, timeout=150.0))
+
+        fleet_thread = threading.Thread(target=run)
+        fleet_thread.start()
+        results = {}
+        try:
+            # all three replicas register during their background warmup
+            # and flip ready once the ladder compiles
+            wait_until(lambda: router._health()["ready"] == 3,
+                       timeout=120, msg="3 ready replicas")
+
+            # Poisson-ish open-loop load: each arrival is its own thread
+            # holding one idempotency-keyed request open at the router
+            rng = np.random.default_rng(0)
+
+            def call(i):
+                prompt = rng.integers(
+                    0, harness.SERVE_VOCAB, 4 + i % 4).tolist()
+                t0 = time.monotonic()
+                code, body, _ = _post(
+                    router.port, "/generate",
+                    {"prompt_ids": prompt, "max_new_tokens": MAX_NEW,
+                     "timeout_s": SLO_S, "idempotency_key": f"req-{i}"})
+                results[i] = {"code": code, "body": body,
+                              "latency": time.monotonic() - t0}
+
+            threads = []
+            for i in range(N_REQUESTS):
+                t = threading.Thread(target=call, args=(i,))
+                t.start()
+                threads.append(t)
+                time.sleep(float(rng.exponential(0.08)))
+            for t in threads:
+                t.join(timeout=SLO_S + 10)
+            assert len(results) == N_REQUESTS
+
+            # give the victims' leases time to lapse, then observe
+            time.sleep(2.5)
+            view = router.view(force=True)
+        finally:
+            store.put("ctl/stop", b"1", overwrite=True)
+            fleet_thread.join(timeout=150)
+            router.stop()
+
+        # ---- zero dropped: every accepted request answered 200 within
+        # its SLO, full output
+        for i, r in sorted(results.items()):
+            assert r["code"] == 200, (i, r)
+            assert len(r["body"]["tokens"]) == MAX_NEW, (i, r)
+            assert r["latency"] < SLO_S, (i, r["latency"])
+
+        # ---- failover happened and replays landed on a survivor
+        replayed = {i: r for i, r in results.items()
+                    if r["body"]["attempts"] >= 2}
+        assert replayed, "kill landed mid-decode but nothing replayed"
+        for i, r in replayed.items():
+            assert r["body"]["replica"] not in ("r0",), (i, r)
+        assert router.registry.get("fleet_failovers_total").total() >= 1
+
+        # ---- zero double-serve: one final answer per idempotency key,
+        # failed attempts on the audit trail with non-200 codes
+        audit = router._audit
+        for i in range(N_REQUESTS):
+            trail = audit[f"req-{i}"]
+            assert trail["code"] == 200
+            finals = [a for a in trail["attempts"] if a["code"] == 200]
+            assert len(finals) == 1, (i, trail)
+            for a in trail["attempts"][:-1]:
+                assert a["code"] != 200, (i, trail)
+
+        # ---- the timeline names the failover hop router->replica
+        fspans = router.tracer.find("fleet.failover")
+        assert any(s.attributes["from_replica"] in ("r0", "r1")
+                   for s in fspans)
+        by_trace = {}
+        for s in router.tracer.find("fleet.replica_call"):
+            by_trace.setdefault(s.trace_id, []).append(s)
+        assert any(len(v) >= 2 for v in by_trace.values())
+
+        # ---- membership transitions: three joins, and the dead
+        # replicas evicted via their lapsed (attested) leases
+        trans = router.registry.get("membership_transitions_total")
+        for h in ("r0", "r1", "r2"):
+            assert trans.value(event="join", host=h) >= 1, h
+        assert trans.value(event="evict", host="r0") >= 1
+        assert trans.value(event="evict", host="r1") >= 1
+        assert not view["r0"]["alive"]
+        assert not view["r1"]["alive"]
+        assert view["r2"]["alive"] and not view["r2"]["done"]
+
+        # ---- process outcomes: SIGTERM killed r0; r1 either wedged
+        # until reclaimed or limped out through the drain-timeout path;
+        # r2 exited clean
+        assert fleet_out["r0"]["rc"] == -15, fleet_out["r0"]
+        assert fleet_out["r1"]["rc"] in ("killed_hung", 0, -9), \
+            fleet_out["r1"]
+        assert fleet_out["r2"]["rc"] == 0, fleet_out["r2"]["stderr"][-2000:]
+
+        # ---- the survivor served the replays and drained clean
+        r2 = harness.fleet_result(configs[2])
+        assert r2 is not None
+        # "served" counts /predict examples; generate traffic shows up
+        # as 200s on the response counter
+        assert r2["responses"].get("200", 0) > 0
+        assert r2["drain_ok"] >= 1
+        assert r2["heartbeats_published"] > 0
+
+
+def _post(port, path, payload):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=SLO_S + 10) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)

@@ -1067,11 +1067,29 @@ class TestMetricsConventions:
                                    prefix_cache=True, kv_dtype="int8")
         sched = DecodeScheduler(engine, registry=reg,
                                 start_thread=False)
+        # the serving-fleet tier (ISSUE 20): router/agent families plus
+        # the drain-outcome counter on the replica side
+        from deeplearning4j_tpu.serving import fleet as _fleet
+        from deeplearning4j_tpu.serving import server as _server
+        _fleet.requests_counter(reg)
+        _fleet.failovers_counter(reg)
+        _fleet.heartbeats_counter(reg)
+        _fleet.router_latency_histogram(reg)
+        _fleet.live_replicas_gauge(reg)
+        _fleet.ready_replicas_gauge(reg)
+        _fleet.shed_counter(reg)
+        _server.drain_counter(reg)
         problems = _lint_registry(reg, "representative")
         assert not problems, "\n".join(problems)
         assert reg.get("decode_goodput_tokens_total") is not None
         for fam in ("kv_prefix_hits_total", "kv_prefix_hit_pages_total",
                     "kv_pages_shared", "kv_page_refcount",
                     "kv_pages_cow_total"):
+            assert reg.get(fam) is not None, fam
+        for fam in ("fleet_requests_total", "fleet_failovers_total",
+                    "fleet_heartbeats_total",
+                    "fleet_request_latency_seconds",
+                    "fleet_live_replicas", "fleet_ready_replicas",
+                    "serving_drain_total"):
             assert reg.get(fam) is not None, fam
         assert sched is not None  # keep the weak gauges alive till here
